@@ -1,0 +1,45 @@
+"""Layer & criterion library (reference: dl/.../bigdl/nn/, 138 files)."""
+
+from bigdl_tpu.nn.module import Module, Container, Criterion, Identity, Echo
+from bigdl_tpu.nn.containers import (Sequential, Concat, ConcatTable,
+                                     ParallelTable, MapTable, Bottle)
+from bigdl_tpu.nn.linear import (Linear, Bilinear, LookupTable, Cosine,
+                                 Euclidean, Add, CAdd, CMul, Mul, MM, MV)
+from bigdl_tpu.nn.activations import (
+    ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, Tanh, TanhShrink, Sigmoid,
+    LogSigmoid, SoftMax, SoftMin, LogSoftMax, SoftPlus, SoftSign, HardTanh,
+    HardShrink, SoftShrink, Threshold, Clamp, Power, Sqrt, Square, Abs, Log,
+    Exp, GradientReversal, Scale)
+from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialShareConvolution,
+                               SpatialFullConvolution,
+                               SpatialDilatedConvolution,
+                               SpatialConvolutionMap)
+from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
+                                  RoiPooling)
+from bigdl_tpu.nn.normalization import (
+    BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN,
+    Normalize, SpatialDivisiveNormalization, SpatialSubtractiveNormalization,
+    SpatialContrastiveNormalization)
+from bigdl_tpu.nn.dropout import Dropout, L1Penalty
+from bigdl_tpu.nn.structural import (
+    Reshape, InferReshape, View, Transpose, Squeeze, Unsqueeze, Select,
+    SelectTable, Narrow, NarrowTable, Index, JoinTable, SplitTable,
+    FlattenTable, Replicate, Padding, SpatialZeroPadding, Copy, Contiguous,
+    Sum, Mean, Max, Min)
+from bigdl_tpu.nn.table_ops import (CAddTable, CSubTable, CMulTable,
+                                    CDivTable, CMaxTable, CMinTable,
+                                    DotProduct, PairwiseDistance,
+                                    CosineDistance)
+from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, GRU, Recurrent,
+                                    BiRecurrent, TimeDistributed)
+from bigdl_tpu.nn.criterion import (
+    ClassNLLCriterion, MSECriterion, BCECriterion, CrossEntropyCriterion,
+    ClassSimplexCriterion, AbsCriterion, CosineEmbeddingCriterion,
+    DistKLDivCriterion, HingeEmbeddingCriterion, L1Cost,
+    L1HingeEmbeddingCriterion, MarginCriterion, MarginRankingCriterion,
+    MultiCriterion, MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
+    MultiMarginCriterion, SmoothL1Criterion, SmoothL1CriterionWithWeights,
+    SoftMarginCriterion, SoftmaxWithCriterion, ParallelCriterion,
+    TimeDistributedCriterion, CriterionTable)
+from bigdl_tpu.nn.detection import Nms, nms
+from bigdl_tpu.nn import init  # noqa: F401

@@ -1,0 +1,281 @@
+"""Activation modules.
+
+Reference parity (all in dl/.../bigdl/nn/): ReLU, ReLU6, PReLU, RReLU,
+LeakyReLU, ELU, Tanh, TanhShrink, Sigmoid, LogSigmoid, SoftMax, SoftMin,
+LogSoftMax, SoftPlus, SoftSign, HardTanh, HardShrink, SoftShrink, Threshold,
+Clamp, Power, Sqrt, Square, Abs, Log, Exp, GradientReversal, Scale.
+The reference threads several of these over ``Engine.model.invoke``
+(SURVEY §2.3); here XLA fuses them into neighbouring ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.tensor import default_dtype
+
+__all__ = ["ReLU", "ReLU6", "PReLU", "RReLU", "LeakyReLU", "ELU", "Tanh",
+           "TanhShrink", "Sigmoid", "LogSigmoid", "SoftMax", "SoftMin",
+           "LogSoftMax", "SoftPlus", "SoftSign", "HardTanh", "HardShrink",
+           "SoftShrink", "Threshold", "Clamp", "Power", "Sqrt", "Square",
+           "Abs", "Log", "Exp", "GradientReversal", "Scale"]
+
+
+class _Elementwise(Module):
+    """Parameterless elementwise activation."""
+
+    def fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.fn(x), state
+
+
+class Threshold(_Elementwise):
+    """x > threshold ? x : value (reference nn/Threshold.scala; supports
+    in-place in the reference — meaningless under XLA)."""
+
+    def __init__(self, threshold: float = 1e-6, value: float = 0.0,
+                 ip: bool = False):
+        super().__init__()
+        self.th, self.value = threshold, value
+
+    def fn(self, x):
+        return jnp.where(x > self.th, x, jnp.asarray(self.value, x.dtype))
+
+
+class ReLU(Threshold):
+    """(reference nn/ReLU.scala: Threshold(0, 0))"""
+
+    def __init__(self, ip: bool = False):
+        super().__init__(0.0, 0.0)
+
+    def fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def fn(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class PReLU(Module):
+    """Learned negative slope, shared or per-channel
+    (reference nn/PReLU.scala; nOutputPlane=0 → single shared slope)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+
+    def init(self, rng):
+        n = max(self.n_output_plane, 1)
+        return {"weight": jnp.full((n,), 0.25, default_dtype())}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = params["weight"]
+        if self.n_output_plane > 0:
+            # channel axis is 1 for NCHW activations, -1 for (N, C)
+            shape = [1] * x.ndim
+            shape[1 if x.ndim > 2 else -1] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(x >= 0, x, w * x), state
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (reference nn/RReLU.scala): slope ~ U(lower,
+    upper) in training, (lower+upper)/2 in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            if rng is None:
+                raise ValueError("RReLU needs an rng key in training mode")
+            a = jax.random.uniform(rng, x.shape, x.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = jnp.asarray((self.lower + self.upper) / 2, x.dtype)
+        return jnp.where(x >= 0, x, a * x), state
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def fn(self, x):
+        return jnp.where(x >= 0, x, self.negval * x)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def fn(self, x):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class Tanh(_Elementwise):
+    fn = staticmethod(jnp.tanh)
+
+
+class TanhShrink(_Elementwise):
+    def fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class LogSigmoid(_Elementwise):
+    fn = staticmethod(jax.nn.log_sigmoid)
+
+
+class SoftMax(_Elementwise):
+    """Softmax over the feature axis (reference nn/SoftMax.scala, threaded;
+    last axis here)."""
+
+    def fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(_Elementwise):
+    def fn(self, x):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSoftMax(_Elementwise):
+    """(reference nn/LogSoftMax.scala, threaded per-sample)"""
+
+    def fn(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def fn(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class HardTanh(_Elementwise):
+    """(reference nn/HardTanh.scala, threaded)"""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    """(reference nn/Clamp.scala: HardTanh with int bounds)"""
+
+    def __init__(self, min_value: int, max_value: int):
+        super().__init__(float(min_value), float(max_value))
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x,
+                         jnp.zeros_like(x))
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lambd, 0.0)
+
+
+class Power(_Elementwise):
+    """(shift + scale * x)^power (reference nn/Power.scala)"""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Sqrt(_Elementwise):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Square(_Elementwise):
+    fn = staticmethod(jnp.square)
+
+
+class Abs(_Elementwise):
+    fn = staticmethod(jnp.abs)
+
+
+class Log(_Elementwise):
+    fn = staticmethod(jnp.log)
+
+
+class Exp(_Elementwise):
+    fn = staticmethod(jnp.exp)
+
+
+class GradientReversal(_Elementwise):
+    """Identity forward, -lambda * grad backward
+    (reference nn/GradientReversal.scala)."""
+
+    def __init__(self, lambd: float = 1.0):
+        super().__init__()
+        self.lambd = lambd
+
+    def fn(self, x):
+        lam = self.lambd
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        def fwd(v):
+            return v, None
+
+        def bwd(_, g):
+            return (-lam * g,)
+
+        rev.defvjp(fwd, bwd)
+        return rev(x)
+
+
+class Scale(Module):
+    """cmul + cadd by learned per-channel weight/bias
+    (reference nn/Scale.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+
+    def init(self, rng):
+        return {"weight": jnp.ones(self.size, default_dtype()),
+                "bias": jnp.zeros(self.size, default_dtype())}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"] + params["bias"], state

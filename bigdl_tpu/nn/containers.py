@@ -1,0 +1,124 @@
+"""Container modules.
+
+Reference parity: Sequential (nn/Sequential.scala:28-52), Concat
+(nn/Concat.scala:42-80), ConcatTable, ParallelTable, Bottle
+(all in dl/.../bigdl/nn/). The reference threads output-copies through
+``Engine.model.invoke``; here XLA fuses the concatenation — no manual
+threading.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module, _fold
+
+__all__ = ["Sequential", "Concat", "ConcatTable", "ParallelTable", "Bottle",
+           "MapTable"]
+
+
+class Sequential(Container):
+    """Chain children (reference nn/Sequential.scala:28-52)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state = {}
+        for i, m in enumerate(self.modules):
+            x, s = m.apply(params[str(i)], state[str(i)], x,
+                           training=training, rng=_fold(rng, i))
+            new_state[str(i)] = s
+        return x, new_state
+
+
+class Concat(Container):
+    """Run children on the same input, concat outputs along ``dimension``
+    (reference nn/Concat.scala; 1-based dim in the reference, here 0-based
+    with the batch at axis 0 — reference dim=2 on NCHW == axis=1 here)."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            y, s = m.apply(params[str(i)], state[str(i)], x,
+                           training=training, rng=_fold(rng, i))
+            outs.append(y)
+            new_state[str(i)] = s
+        return jnp.concatenate(outs, axis=self.dimension), new_state
+
+
+class ConcatTable(Container):
+    """Run children on the same input, return tuple of outputs
+    (reference nn/ConcatTable.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            y, s = m.apply(params[str(i)], state[str(i)], x,
+                           training=training, rng=_fold(rng, i))
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th element of the input table
+    (reference nn/ParallelTable.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            y, s = m.apply(params[str(i)], state[str(i)], x[i],
+                           training=training, rng=_fold(rng, i))
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class MapTable(Container):
+    """Apply the single child to every element of the input table
+    (reference nn/MapTable.scala). Parameters are shared across elements."""
+
+    def __init__(self, module: Module | None = None):
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def init(self, rng):
+        return {"0": self.modules[0].init(rng)}
+
+    def init_state(self):
+        return {"0": self.modules[0].init_state()}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        m = self.modules[0]
+        outs = []
+        s = state["0"]
+        for i, xi in enumerate(x):
+            y, s = m.apply(params["0"], s, xi, training=training,
+                           rng=_fold(rng, i))
+            outs.append(y)
+        return tuple(outs), {"0": s}
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply child, restore (reference nn/Bottle.scala).
+
+    ``n_input_dim`` is the child's expected input rank.
+    """
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 n_output_dim: int | None = None):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim or n_input_dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        shape = x.shape
+        lead = shape[:len(shape) - self.n_input_dim + 1]
+        squashed = x.reshape((-1,) + shape[len(shape) - self.n_input_dim + 1:])
+        y, s = self.modules[0].apply(params["0"], state["0"], squashed,
+                                     training=training, rng=rng)
+        y = y.reshape(lead + y.shape[1:])
+        return y, {"0": s}

@@ -1,0 +1,57 @@
+"""Detection helpers.
+
+Reference parity: Nms (nn/Nms.scala — greedy non-max suppression used by
+Fast-RCNN support code), alongside RoiPooling (pooling.py) and
+SmoothL1CriterionWithWeights (criterion.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Nms", "nms"]
+
+
+def nms(boxes, scores, iou_threshold: float, max_output: int):
+    """Greedy NMS with static output size (XLA-friendly).
+
+    boxes: (N, 4) [x1, y1, x2, y2]; returns (indices, valid_mask) of length
+    ``max_output``.
+    """
+    order = jnp.argsort(-scores)
+    boxes = boxes[order]
+    areas = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+    n = boxes.shape[0]
+
+    def iou(i, j):
+        xx1 = jnp.maximum(boxes[i, 0], boxes[j, 0])
+        yy1 = jnp.maximum(boxes[i, 1], boxes[j, 1])
+        xx2 = jnp.minimum(boxes[i, 2], boxes[j, 2])
+        yy2 = jnp.minimum(boxes[i, 3], boxes[j, 3])
+        w = jnp.maximum(0.0, xx2 - xx1 + 1)
+        h = jnp.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        return inter / (areas[i] + areas[j] - inter)
+
+    def body(i, keep_mask):
+        # suppress j>i overlapping with i if i is still kept
+        js = jnp.arange(n)
+        ious = jax.vmap(lambda j: iou(i, j))(js)
+        suppress = (ious > iou_threshold) & (js > i) & keep_mask[i]
+        return jnp.where(suppress, False, keep_mask)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept_sorted = jnp.nonzero(keep, size=max_output, fill_value=-1)[0]
+    valid = kept_sorted >= 0
+    return jnp.where(valid, order[jnp.clip(kept_sorted, 0)], -1), valid
+
+
+class Nms:
+    """Object-style wrapper matching the reference's Nms API."""
+
+    def __init__(self, iou_threshold: float = 0.3, max_output: int = 100):
+        self.iou_threshold = iou_threshold
+        self.max_output = max_output
+
+    def __call__(self, boxes, scores):
+        return nms(boxes, scores, self.iou_threshold, self.max_output)

@@ -1,0 +1,71 @@
+"""Regularization layers.
+
+Reference parity: Dropout (nn/Dropout.scala:28-100 — initP=0.5, scale by
+1/(1-p) in train, pass-through in eval, bernoulli noise), L1Penalty.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["Dropout", "L1Penalty"]
+
+
+class Dropout(Module):
+    """(reference nn/Dropout.scala)"""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float):
+        self.p = p
+        return self
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout needs an rng key in training mode")
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, jnp.shape(x))
+        y = jnp.where(keep, x, jnp.zeros_like(x))
+        if self.scale:
+            y = y / (1.0 - self.p)
+        return y, state
+
+    def __repr__(self):
+        return f"Dropout({self.p})"
+
+
+class L1Penalty(Module):
+    """Identity forward that adds an L1 sparsity gradient in backward
+    (reference nn/L1Penalty.scala). Implemented with a custom VJP so
+    autodiff reproduces ``gradInput += l1weight * sign(input)``."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = self.l1weight
+        if self.size_average:
+            w = w / jnp.size(x)
+
+        @jax.custom_vjp
+        def pen(v):
+            return v
+
+        def fwd(v):
+            return v, jnp.sign(v)
+
+        def bwd(sign, g):
+            return (g + w * sign,)
+
+        pen.defvjp(fwd, bwd)
+        return (pen(x) if training else x), state

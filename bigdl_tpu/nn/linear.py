@@ -1,0 +1,238 @@
+"""Linear / embedding family.
+
+Reference parity: Linear (nn/Linear.scala, 218 LoC), Bilinear, LookupTable
+(nn/LookupTable.scala:32-105), Cosine, Euclidean, Add, CAdd, CMul, Mul, MM, MV
+(all in dl/.../bigdl/nn/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.tensor import compute_dtype, default_dtype
+
+__all__ = ["Linear", "Bilinear", "LookupTable", "Cosine", "Euclidean",
+           "Add", "CAdd", "CMul", "Mul", "MM", "MV"]
+
+
+class Linear(Module):
+    """y = x W^T + b (reference nn/Linear.scala; default init
+    stdv = 1/sqrt(inputSize))."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 init_method: str = init_mod.Default):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.init_method = init_method
+
+    def init(self, rng):
+        kw, kb = jax.random.split(rng)
+        p = {"weight": init_mod.init_weight(
+            self.init_method, kw, (self.output_size, self.input_size),
+            fan_in=self.input_size, fan_out=self.output_size)}
+        if self.with_bias:
+            stdv = (1.0 / np.sqrt(self.input_size)
+                    if self.init_method == init_mod.Default else 0.0)
+            p["bias"] = (init_mod.uniform_reset(kb, (self.output_size,), stdv)
+                         if stdv else jnp.zeros((self.output_size,),
+                                                default_dtype()))
+        return p
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = params["weight"].astype(compute_dtype())
+        y = jnp.matmul(x.astype(compute_dtype()), w.T)
+        if self.with_bias:
+            y = y + params["bias"].astype(compute_dtype())
+        return y.astype(params["weight"].dtype), state
+
+    def __repr__(self):
+        return f"Linear({self.input_size} -> {self.output_size})"
+
+
+class Bilinear(Module):
+    """y_k = x1 W_k x2^T + b_k over a table input (x1, x2)
+    (reference nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True):
+        super().__init__()
+        self.n1, self.n2, self.n_out = input_size1, input_size2, output_size
+        self.bias_res = bias_res
+
+    def init(self, rng):
+        kw, kb = jax.random.split(rng)
+        stdv = 1.0 / np.sqrt(self.n1)
+        p = {"weight": init_mod.uniform_reset(
+            kw, (self.n_out, self.n1, self.n2), stdv)}
+        if self.bias_res:
+            p["bias"] = init_mod.uniform_reset(kb, (self.n_out,), stdv)
+        return p
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x1, x2 = x
+        y = jnp.einsum("bi,kij,bj->bk", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y, state
+
+
+class LookupTable(Module):
+    """Embedding lookup (reference nn/LookupTable.scala:32-105).
+
+    Indices are 1-based like the reference. ``padding_value`` rows embed to
+    whatever is stored (the reference zeroes their gradient — autodiff does
+    that automatically since a stop-gradient mask is applied), ``max_norm``
+    renormalizes looked-up rows.
+    """
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float | None = None, norm_type: float = 2.0):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = int(padding_value)
+        self.max_norm, self.norm_type = max_norm, norm_type
+
+    def init(self, rng):
+        return {"weight": jax.random.normal(
+            rng, (self.n_index, self.n_output), default_dtype())}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        idx = x.astype(jnp.int32) - 1  # reference is 1-based
+        w = params["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+        y = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value:
+            mask = (idx != self.padding_value - 1)[..., None]
+            y = jnp.where(mask, y, jax.lax.stop_gradient(y))
+        return y, state
+
+
+class Cosine(Module):
+    """Cosine similarity vs each weight row (reference nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+
+    def init(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"weight": init_mod.uniform_reset(
+            rng, (self.output_size, self.input_size), stdv)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        w = params["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return jnp.matmul(xn, wn.T), state
+
+
+class Euclidean(Module):
+    """L2 distance to each weight column (reference nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+
+    def init(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"weight": init_mod.uniform_reset(
+            rng, (self.output_size, self.input_size), stdv)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        diff = x[..., None, :] - params["weight"]
+        return jnp.linalg.norm(diff, axis=-1), state
+
+
+class Add(Module):
+    """Learned bias add (reference nn/Add.scala)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+
+    def init(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"bias": init_mod.uniform_reset(rng, (self.input_size,), stdv)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + params["bias"], state
+
+
+class CAdd(Module):
+    """Learned elementwise bias of arbitrary broadcast shape
+    (reference nn/CAdd.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+
+    def init(self, rng):
+        stdv = 1.0 / np.sqrt(int(np.prod(self.size)))
+        return {"bias": init_mod.uniform_reset(rng, self.size, stdv)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + params["bias"], state
+
+
+class CMul(Module):
+    """Learned elementwise scale (reference nn/CMul.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+
+    def init(self, rng):
+        stdv = 1.0 / np.sqrt(int(np.prod(self.size)))
+        return {"weight": init_mod.uniform_reset(rng, self.size, stdv)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"], state
+
+
+class Mul(Module):
+    """Single learned scalar scale (reference nn/Mul.scala)."""
+
+    def init(self, rng):
+        return {"weight": init_mod.uniform_reset(rng, (1,), 1.0)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * params["weight"][0], state
+
+
+class MM(Module):
+    """Batch matrix-matrix product of a table (a, b)
+    (reference nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+
+class MV(Module):
+    """Batch matrix-vector product of a table (m, v)
+    (reference nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        m, v = x
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), state

@@ -1,0 +1,226 @@
+"""Normalization layers.
+
+Reference parity: BatchNormalization (nn/BatchNormalization.scala:30-104 —
+eps=1e-5, momentum=0.1, optional affine, runningMean/runningVar updated in
+train and used in eval), SpatialBatchNormalization, SpatialCrossMapLRN,
+SpatialContrastiveNormalization, SpatialDivisiveNormalization,
+SpatialSubtractiveNormalization, Normalize.
+
+BN under data parallelism: the reference's statistics are per-replica
+(per-core model clone, SURVEY §7 "hard parts"). Here statistics are computed
+over the device-local batch by default; pass ``axis_name`` to sync across a
+mesh axis with ``lax.pmean`` (the idiomatic TPU upgrade).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.tensor import default_dtype
+
+__all__ = ["BatchNormalization", "SpatialBatchNormalization",
+           "SpatialCrossMapLRN", "Normalize",
+           "SpatialDivisiveNormalization", "SpatialSubtractiveNormalization",
+           "SpatialContrastiveNormalization"]
+
+
+class BatchNormalization(Module):
+    """1-D batch norm over (N, C) (reference nn/BatchNormalization.scala)."""
+
+    n_dim = 2
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 axis_name: str | None = None):
+        super().__init__()
+        self.n_output = n_output
+        self.eps, self.momentum, self.affine = eps, momentum, affine
+        self.axis_name = axis_name
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        # reference reset(): weight ~ U(0,1), bias = 0
+        return {"weight": jax.random.uniform(rng, (self.n_output,),
+                                             default_dtype()),
+                "bias": jnp.zeros((self.n_output,), default_dtype())}
+
+    def init_state(self):
+        return {"running_mean": jnp.zeros((self.n_output,), default_dtype()),
+                "running_var": jnp.ones((self.n_output,), default_dtype())}
+
+    def _reduce_axes(self, x):
+        return tuple(i for i in range(x.ndim) if i != 1)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        squeeze = x.ndim == self.n_dim + 1
+        if squeeze:
+            x = x[None]
+        axes = self._reduce_axes(x)
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            if self.axis_name is not None:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                var = jax.lax.pmean(var, self.axis_name)
+            n = np.prod([x.shape[a] for a in axes])
+            unbiased = var * n / max(n - 1, 1)
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        shape = [1] * x.ndim
+        shape[1] = self.n_output
+        y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + self.eps)
+        if self.affine:
+            y = y * params["weight"].reshape(shape) + \
+                params["bias"].reshape(shape)
+        if squeeze:
+            y = y[0]
+        return y, new_state
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.n_output})"
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """4-D (N, C, H, W) wrapper (reference nn/SpatialBatchNormalization.scala)."""
+
+    n_dim = 4
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet/Inception local response normalization across channels
+    (reference nn/SpatialCrossMapLRN.scala, threaded; here one fused
+    reduce_window over the channel axis).
+
+    y = x / (k + alpha/size * sum_{local} x^2)^beta
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, k: float = 1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        half = (self.size - 1) // 2
+        sq = jnp.square(x)
+        ssum = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)))
+        den = jnp.power(self.k + (self.alpha / self.size) * ssum, self.beta)
+        return x / den, state
+
+
+class Normalize(Module):
+    """Lp-normalize over the feature axis (reference nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p, self.eps = p, eps
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if np.isinf(self.p):
+            n = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), self.p), axis=-1,
+                                  keepdims=True), 1.0 / self.p)
+        return x / jnp.maximum(n, self.eps), state
+
+
+def _gaussian_kernel(kernel_size: int) -> np.ndarray:
+    """Default 2-D gaussian used by the reference's subtractive/divisive
+    normalization (Torch image.gaussian semantics)."""
+    sigma = 0.25 * kernel_size  # torch default sigma=0.25 relative
+    ax = np.arange(kernel_size) - (kernel_size - 1) / 2.0
+    g = np.exp(-(ax ** 2) / (2 * sigma ** 2))
+    k = np.outer(g, g)
+    return (k / k.sum()).astype(np.float32)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract local weighted mean (reference
+    nn/SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        k = np.asarray(kernel, np.float32) if kernel is not None \
+            else _gaussian_kernel(9)
+        self.kernel = k / (k.sum() * n_input_plane)
+
+    def _local_mean(self, x):
+        kh, kw = self.kernel.shape
+        w = jnp.asarray(self.kernel)[None, None].repeat(
+            self.n_input_plane, axis=1)
+        mean = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), (1, 1),
+            padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # divide by local window mass (border correction, as Torch does via
+        # convolving a ones image)
+        ones = jnp.ones((1, self.n_input_plane) + x.shape[2:], x.dtype)
+        coef = jax.lax.conv_general_dilated(
+            ones, w.astype(x.dtype), (1, 1),
+            padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return mean / (coef * self.n_input_plane)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        y = x - self._local_mean(x)
+        if squeeze:
+            y = y[0]
+        return y, state
+
+
+class SpatialDivisiveNormalization(SpatialSubtractiveNormalization):
+    """Divide by local weighted std (reference
+    nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__(n_input_plane, kernel)
+        self.threshold, self.thresval = threshold, thresval
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        local_std = jnp.sqrt(jnp.maximum(self._local_mean(jnp.square(x)),
+                                         0.0))
+        mean_std = jnp.mean(local_std, axis=(2, 3), keepdims=True)
+        den = jnp.maximum(local_std, mean_std)
+        den = jnp.where(den < self.threshold, self.thresval, den)
+        y = x / den
+        if squeeze:
+            y = y[0]
+        return y, state
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization (reference
+    nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, _ = self.sub.apply({}, {}, x, training=training)
+        y, _ = self.div.apply({}, {}, y, training=training)
+        return y, state

@@ -1,0 +1,153 @@
+"""Pooling layers (NCHW).
+
+Reference parity: SpatialMaxPooling (nn/SpatialMaxPooling.scala, 275 LoC,
+threaded), SpatialAveragePooling (threaded), RoiPooling (Fast-RCNN support).
+TPU-first: ``lax.reduce_window`` — XLA fuses and parallelizes; ceil_mode is
+reproduced by asymmetric extra padding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["SpatialMaxPooling", "SpatialAveragePooling", "RoiPooling"]
+
+
+def _pool_out(size, k, d, pad, ceil_mode):
+    if ceil_mode:
+        return int(np.ceil((size + 2 * pad - k) / d)) + 1
+    return int(np.floor((size + 2 * pad - k) / d)) + 1
+
+
+class _Pool2d(Module):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pw, self.ph = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        """(reference SpatialMaxPooling.ceil())"""
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _padding(self, h, w):
+        """(lo, hi) padding per spatial dim, extending for ceil_mode."""
+        oh = _pool_out(h, self.kh, self.dh, self.ph, self.ceil_mode)
+        ow = _pool_out(w, self.kw, self.dw, self.pw, self.ceil_mode)
+        # Torch clamps so the last window starts inside the (padded) input
+        if self.ph > 0 or self.pw > 0:
+            if (oh - 1) * self.dh >= h + self.ph:
+                oh -= 1
+            if (ow - 1) * self.dw >= w + self.pw:
+                ow -= 1
+        hi_h = max((oh - 1) * self.dh + self.kh - h - self.ph, self.ph)
+        hi_w = max((ow - 1) * self.dw + self.kw - w - self.pw, self.pw)
+        return (self.ph, hi_h), (self.pw, hi_w)
+
+
+class SpatialMaxPooling(_Pool2d):
+    """(reference nn/SpatialMaxPooling.scala)"""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        ph, pw = self._padding(x.shape[2], x.shape[3])
+        y = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), ph, pw))
+        if squeeze:
+            y = y[0]
+        return y, state
+
+
+class SpatialAveragePooling(_Pool2d):
+    """(reference nn/SpatialAveragePooling.scala; ``count_include_pad``
+    matches Torch's default True)."""
+
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 count_include_pad: bool = True, divide: bool = True):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h)
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        ph, pw = self._padding(x.shape[2], x.shape[3])
+        pad = ((0, 0), (0, 0), ph, pw)
+        y = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw), padding=pad)
+        if self.divide:
+            if self.count_include_pad:
+                y = y / (self.kh * self.kw)
+            else:
+                ones = jnp.ones_like(x)
+                cnt = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add,
+                    window_dimensions=(1, 1, self.kh, self.kw),
+                    window_strides=(1, 1, self.dh, self.dw), padding=pad)
+                y = y / cnt
+        if squeeze:
+            y = y[0]
+        return y, state
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling (reference nn/RoiPooling.scala).
+
+    Input: (features NCHW, rois (R, 5) of [batch_idx, x1, y1, x2, y2]).
+    Fixed-size loop over pooled cells keeps shapes static for XLA.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float):
+        super().__init__()
+        self.pw, self.ph = pooled_w, pooled_h
+        self.scale = spatial_scale
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        feats, rois = x
+        H, W = feats.shape[2], feats.shape[3]
+
+        def pool_one(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * self.scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * self.scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * self.scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+            rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+            bin_h, bin_w = rh / self.ph, rw / self.pw
+            fmap = feats[b]
+
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            # (ph, H) / (pw, W) membership masks per pooled cell
+            i = jnp.arange(self.ph)[:, None].astype(jnp.float32)
+            j = jnp.arange(self.pw)[:, None].astype(jnp.float32)
+            hs = jnp.floor(i * bin_h).astype(jnp.int32) + y1
+            he = jnp.ceil((i + 1) * bin_h).astype(jnp.int32) + y1
+            ws = jnp.floor(j * bin_w).astype(jnp.int32) + x1
+            we = jnp.ceil((j + 1) * bin_w).astype(jnp.int32) + x1
+            hmask = (ys >= hs) & (ys < jnp.minimum(he, H))  # (ph, H)
+            wmask = (xs >= ws) & (xs < jnp.minimum(we, W))  # (pw, W)
+            m = hmask[:, None, :, None] & wmask[None, :, None, :]
+            vals = jnp.where(m[None], fmap[:, None, None, :, :], -jnp.inf)
+            out = vals.max(axis=(-1, -2))  # (C, ph, pw)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(pool_one)(rois.astype(jnp.float32)), state

@@ -1,0 +1,335 @@
+"""Structural / shape-manipulation modules.
+
+Reference parity (all in dl/.../bigdl/nn/): Reshape, InferReshape, View,
+Transpose, Squeeze, Unsqueeze, Select, SelectTable, Narrow, NarrowTable,
+Index, JoinTable, SplitTable, FlattenTable, Replicate, Padding,
+SpatialZeroPadding, Copy, Contiguous, Sum, Mean, Max, Min.
+
+Dim conventions: the reference is 1-based Torch; here dims are 0-based
+Python/JAX, and negative dims count from the end.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["Reshape", "InferReshape", "View", "Transpose", "Squeeze",
+           "Unsqueeze", "Select", "SelectTable", "Narrow", "NarrowTable",
+           "Index", "JoinTable", "SplitTable", "FlattenTable", "Replicate",
+           "Padding", "SpatialZeroPadding", "Copy", "Contiguous",
+           "Sum", "Mean", "Max", "Min"]
+
+
+class Reshape(Module):
+    """Reshape non-batch dims (reference nn/Reshape.scala; ``batch_mode``
+    None=infer like the reference)."""
+
+    def __init__(self, size, batch_mode: bool | None = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        import numpy as np
+        n = int(np.prod(self.size))
+        batch = (self.batch_mode if self.batch_mode is not None
+                 else x.ndim > len(self.size) and x.size != n)
+        if batch:
+            return x.reshape((x.shape[0],) + self.size), state
+        return x.reshape(self.size), state
+
+
+class InferReshape(Module):
+    """Reshape with -1 inference and 0 = copy-input-dim
+    (reference nn/InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        offset = 1 if self.batch_mode else 0
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(x.shape[i + offset] if s == 0 else s)
+        if self.batch_mode:
+            out = [x.shape[0]] + out
+        return x.reshape(tuple(out)), state
+
+
+class View(Module):
+    """(reference nn/View.scala; keeps batch dim, supports num_input_dims)"""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        import numpy as np
+        n = int(np.prod([s for s in self.sizes if s > 0]))
+        if x.size == n and -1 not in self.sizes:
+            return x.reshape(self.sizes), state
+        return x.reshape((x.shape[0],) + self.sizes), state
+
+
+class Transpose(Module):
+    """Sequence of pairwise dim swaps (reference nn/Transpose.scala)."""
+
+    def __init__(self, permutations):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, d1, d2)
+        return x, state
+
+
+class Squeeze(Module):
+    def __init__(self, dim: int | None = None, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.squeeze(x, self.dim), state
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, num_input_dims: int = -1):
+        super().__init__()
+        self.pos = pos
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, self.pos), state
+
+
+class Select(Module):
+    """Select ``index`` along ``dim`` (reference nn/Select.scala)."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.take(x, self.index, axis=self.dim), state
+
+
+class SelectTable(Module):
+    """Select the i-th element of a table (reference nn/SelectTable.scala)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x[self.index], state
+
+
+class Narrow(Module):
+    """Slice ``length`` elements from ``offset`` along ``dim``
+    (reference nn/Narrow.scala; offset 0-based here)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        length = self.length
+        if length < 0:
+            length = x.shape[self.dim] - self.offset + length + 1
+        idx = [slice(None)] * x.ndim
+        idx[self.dim] = slice(self.offset, self.offset + length)
+        return x[tuple(idx)], state
+
+
+class NarrowTable(Module):
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return tuple(x[self.offset:self.offset + self.length]), state
+
+
+class Index(Module):
+    """index_select along dim by the second table element
+    (reference nn/Index.scala; indices 1-based in the reference)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        t, idx = x
+        return jnp.take(t, idx.astype(jnp.int32) - 1, axis=self.dimension), \
+            state
+
+
+class JoinTable(Module):
+    """Concat table elements along ``dimension``
+    (reference nn/JoinTable.scala; n_input_dims enables batch-dim shift)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dim = self.dimension
+        if self.n_input_dims > 0 and x[0].ndim > self.n_input_dims:
+            dim += 1
+        return jnp.concatenate(list(x), axis=dim), state
+
+
+class SplitTable(Module):
+    """Split along ``dimension`` into a table (reference nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dim = self.dimension
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            dim += 1
+        n = x.shape[dim]
+        parts = jnp.split(x, n, axis=dim)
+        return tuple(jnp.squeeze(p, axis=dim) for p in parts), state
+
+
+def _flatten(table, out):
+    for v in table:
+        if isinstance(v, (tuple, list)):
+            _flatten(v, out)
+        else:
+            out.append(v)
+    return out
+
+
+class FlattenTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return tuple(_flatten(x, [])), state
+
+
+class Replicate(Module):
+    """Insert a new dim of size nFeatures by replication
+    (reference nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 0, n_dim: int = -1):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.expand_dims(x, self.dim)
+        reps = [1] * y.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(y, reps), state
+
+
+class Padding(Module):
+    """Pad ``pad`` entries (sign = side) along ``dim`` with ``value``
+    (reference nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = -1,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+        self.n_input_dim = n_input_dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dim = self.dim
+        if self.n_input_dim > 0 and x.ndim > self.n_input_dim:
+            dim += 1
+        cfg = [(0, 0)] * x.ndim
+        cfg[dim] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, cfg, constant_values=self.value), state
+
+
+class SpatialZeroPadding(Module):
+    """(reference nn/SpatialZeroPadding.scala; negative pad crops)"""
+
+    def __init__(self, pad_left: int, pad_right: int | None = None,
+                 pad_top: int | None = None, pad_bottom: int | None = None):
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        def padcrop(arr, axis, lo, hi):
+            if lo < 0:
+                idx = [slice(None)] * arr.ndim
+                idx[axis] = slice(-lo, None)
+                arr = arr[tuple(idx)]
+                lo = 0
+            if hi < 0:
+                idx = [slice(None)] * arr.ndim
+                idx[axis] = slice(None, hi)
+                arr = arr[tuple(idx)]
+                hi = 0
+            cfg = [(0, 0)] * arr.ndim
+            cfg[axis] = (lo, hi)
+            return jnp.pad(arr, cfg)
+
+        x = padcrop(x, x.ndim - 2, self.pt, self.pb)
+        x = padcrop(x, x.ndim - 1, self.pl, self.pr)
+        return x, state
+
+
+class Copy(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.array(x), state
+
+
+class Contiguous(Module):
+    """No-op under XLA (reference nn/Contiguous.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+class _Reduce(Module):
+    def __init__(self, dimension: int = 0, n_input_dims: int = -1,
+                 size_average: bool = False):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+
+    def _dim(self, x):
+        d = self.dimension
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += 1
+        return d
+
+
+class Sum(_Reduce):
+    """(reference nn/Sum.scala; size_average divides by dim size)"""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        d = self._dim(x)
+        y = jnp.sum(x, axis=d)
+        if self.size_average:
+            y = y / x.shape[d]
+        return y, state
+
+
+class Mean(_Reduce):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=self._dim(x)), state
+
+
+class Max(_Reduce):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.max(x, axis=self._dim(x)), state
+
+
+class Min(_Reduce):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.min(x, axis=self._dim(x)), state

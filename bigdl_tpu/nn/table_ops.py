@@ -1,0 +1,82 @@
+"""Table (tuple) arithmetic modules.
+
+Reference parity (all in dl/.../bigdl/nn/): CAddTable, CSubTable, CMulTable,
+CDivTable, CMaxTable, CMinTable, DotProduct, PairwiseDistance,
+CosineDistance, CriterionTable mirror.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from functools import reduce
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
+           "CMinTable", "DotProduct", "PairwiseDistance", "CosineDistance"]
+
+
+class CAddTable(Module):
+    """(reference nn/CAddTable.scala)"""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return reduce(jnp.add, x), state
+
+
+class CSubTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x[0] - x[1], state
+
+
+class CMulTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return reduce(jnp.multiply, x), state
+
+
+class CDivTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x[0] / x[1], state
+
+
+class CMaxTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return reduce(jnp.maximum, x), state
+
+
+class CMinTable(Module):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return reduce(jnp.minimum, x), state
+
+
+class DotProduct(Module):
+    """Row-wise dot product of (a, b) (reference nn/DotProduct.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x
+        return jnp.sum(a * b, axis=-1), state
+
+
+class PairwiseDistance(Module):
+    """Row-wise Lp distance (reference nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x
+        d = jnp.power(jnp.sum(jnp.power(jnp.abs(a - b), self.norm), axis=-1),
+                      1.0 / self.norm)
+        return d, state
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity (reference nn/CosineDistance.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x
+        an = jnp.linalg.norm(a, axis=-1)
+        bn = jnp.linalg.norm(b, axis=-1)
+        return jnp.sum(a * b, axis=-1) / jnp.maximum(an * bn, 1e-12), state

@@ -1,0 +1,115 @@
+"""Tensor layer: dtype policy and pytree/flat-parameter helpers.
+
+The reference implements a full Torch-semantics tensor library
+(tensor/Tensor.scala:35, tensor/TensorMath.scala:38-707, 6.5k LoC) dispatching
+to MKL via JNI. On TPU the tensor layer *is* ``jax.numpy`` on device arrays —
+XLA owns layout, fusion and parallelism — so this package only provides what
+JAX does not: the numeric dtype policy (the reference's ``TensorNumeric``
+typeclass seam, tensor/TensorNumeric.scala:26-525) and the flat-parameter
+view used by optimizers and checkpoints (the reference's ``Module.flatten``,
+nn/Module.scala:41-69).
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DTypePolicy", "get_policy", "set_policy", "policy_scope",
+    "default_dtype", "compute_dtype",
+    "flatten_params", "unflatten_params", "tree_size", "tree_zeros_like",
+]
+
+
+@dataclass(frozen=True)
+class DTypePolicy:
+    """Numeric dispatch seam: parameter dtype vs on-MXU compute dtype.
+
+    Mirrors the reference's NumericFloat/NumericDouble instances
+    (tensor/TensorNumeric.scala:142,332) but TPU-first: the interesting axis
+    on TPU is f32 params with bf16 matmul/conv compute.
+    """
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+
+
+_policy = DTypePolicy()
+
+
+def get_policy() -> DTypePolicy:
+    return _policy
+
+
+def set_policy(policy: DTypePolicy) -> None:
+    global _policy
+    _policy = policy
+
+
+@contextlib.contextmanager
+def policy_scope(policy: DTypePolicy):
+    prev = get_policy()
+    set_policy(policy)
+    try:
+        yield
+    finally:
+        set_policy(prev)
+
+
+def default_dtype() -> jnp.dtype:
+    return _policy.param_dtype
+
+
+def compute_dtype() -> jnp.dtype:
+    return _policy.compute_dtype
+
+
+# ---------------------------------------------------------------------------
+# Flat parameter view (reference: Module.flatten, nn/Module.scala:41-69).
+# The reference physically compacts all layer weights into ONE contiguous
+# storage so whole-model allreduce and Torch-style optimizers work on a single
+# vector. In JAX the native representation is the params pytree; the flat view
+# is materialized only at the seams that want it (LBFGS, checkpoints of the
+# reference's layout, parity adapters).
+# ---------------------------------------------------------------------------
+
+def flatten_params(tree):
+    """Concatenate all leaves of a params pytree into one 1-D vector.
+
+    Returns ``(flat, unravel)`` where ``unravel(flat) -> tree``.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtypes = [l.dtype for l in leaves]
+    if leaves:
+        flat = jnp.concatenate([jnp.ravel(l).astype(jnp.result_type(*dtypes))
+                                for l in leaves])
+    else:
+        flat = jnp.zeros((0,), default_dtype())
+
+    def unravel(vec):
+        out, off = [], 0
+        for shape, size, dt in zip(shapes, sizes, dtypes):
+            out.append(jnp.reshape(vec[off:off + size], shape).astype(dt))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return flat, unravel
+
+
+def tree_size(tree) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def unflatten_params(vec, like_tree):
+    _, unravel = flatten_params(like_tree)
+    return unravel(vec)

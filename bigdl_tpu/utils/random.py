@@ -1,0 +1,62 @@
+"""Deterministic RNG for host-side code (data pipeline, shuffling, init seeds).
+
+Reference parity: utils/RandomGenerator.scala:20-265 — a thread-local,
+Torch-compatible Mersenne-Twister used for reproducible init and shuffling.
+Here device-side randomness uses ``jax.random`` keys (threaded explicitly
+through init/apply — the idiomatic JAX design), while host-side shuffling and
+data augmentation use this MT19937 generator for reproducibility.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["RandomGenerator"]
+
+
+class RandomGenerator:
+    """Thread-local seeded MT19937 (reference: RandomGenerator.scala:22-33)."""
+
+    _local = threading.local()
+    _default_seed = 1
+
+    def __init__(self, seed: int | None = None):
+        self._rng = np.random.Generator(np.random.MT19937(
+            seed if seed is not None else self._default_seed))
+
+    # -- thread-local singleton (reference `RNG`) --
+    @classmethod
+    def RNG(cls) -> "RandomGenerator":
+        inst = getattr(cls._local, "inst", None)
+        if inst is None:
+            inst = cls(cls._default_seed)
+            cls._local.inst = inst
+        return inst
+
+    @classmethod
+    def set_seed(cls, seed: int) -> "RandomGenerator":
+        cls._default_seed = seed
+        cls._local.inst = cls(seed)
+        return cls._local.inst
+
+    # -- draws (reference RandomGenerator.scala:49-265) --
+    def uniform(self, a: float = 0.0, b: float = 1.0, size=None):
+        return self._rng.uniform(a, b, size)
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None):
+        return self._rng.normal(mean, stdv, size)
+
+    def bernoulli(self, p: float, size=None):
+        return (self._rng.random(size) < p).astype(np.float32)
+
+    def random_int(self, low: int, high: int, size=None):
+        return self._rng.integers(low, high, size)
+
+    def shuffle(self, seq):
+        """In-place Fisher-Yates (reference RandomGenerator.scala:36-47)."""
+        self._rng.shuffle(seq)
+        return seq
+
+    def permutation(self, n: int):
+        return self._rng.permutation(n)

@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Mirrors the reference's distributed-test strategy (SURVEY §4.3): the
+reference runs Spark ``local[1]`` with 4 logical partitions to test the
+distributed path without a cluster; here we force an 8-virtual-device CPU
+platform so mesh/pjit/collective code paths run exactly as they would on an
+8-chip TPU slice. The real chip is for bench.py only.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# jax may already be imported (and pointed at the TPU) by the container's
+# sitecustomize hook — override the platform before any backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

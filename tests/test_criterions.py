@@ -1,0 +1,218 @@
+"""Criterion golden tests vs torch (reference test strategy SURVEY §4.2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+
+
+def assert_close(a, b, tol=1e-4):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=tol,
+                               atol=tol)
+
+
+RS = np.random.RandomState(7)
+logits = RS.randn(6, 5).astype(np.float32)
+labels1 = RS.randint(1, 6, (6,)).astype(np.int64)  # 1-based
+
+
+class TestClassNLL:
+    def test_loss_and_grad(self):
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits)))
+        c = nn.ClassNLLCriterion()
+        loss = c.forward(jnp.asarray(logp), jnp.asarray(labels1))
+        ref = F.nll_loss(torch.from_numpy(logp),
+                         torch.from_numpy(labels1 - 1))
+        assert_close(loss, ref.item())
+        g = c.backward(jnp.asarray(logp), jnp.asarray(labels1))
+        t = torch.from_numpy(logp).requires_grad_(True)
+        F.nll_loss(t, torch.from_numpy(labels1 - 1)).backward()
+        assert_close(g, t.grad.numpy())
+
+    def test_weighted(self):
+        w = np.arange(1, 6, dtype=np.float32)
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits)))
+        c = nn.ClassNLLCriterion(weights=w)
+        loss = c.forward(jnp.asarray(logp), jnp.asarray(labels1))
+        ref = F.nll_loss(torch.from_numpy(logp),
+                         torch.from_numpy(labels1 - 1),
+                         weight=torch.from_numpy(w))
+        assert_close(loss, ref.item())
+
+
+class TestCrossEntropy:
+    def test_matches_torch(self):
+        c = nn.CrossEntropyCriterion()
+        loss = c.forward(jnp.asarray(logits), jnp.asarray(labels1))
+        ref = F.cross_entropy(torch.from_numpy(logits),
+                              torch.from_numpy(labels1 - 1))
+        assert_close(loss, ref.item())
+
+
+class TestMSE:
+    def test_loss_and_grad(self):
+        x = RS.randn(4, 3).astype(np.float32)
+        y = RS.randn(4, 3).astype(np.float32)
+        c = nn.MSECriterion()
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(y)),
+                     F.mse_loss(torch.from_numpy(x),
+                                torch.from_numpy(y)).item())
+        g = c.backward(jnp.asarray(x), jnp.asarray(y))
+        t = torch.from_numpy(x).requires_grad_(True)
+        F.mse_loss(t, torch.from_numpy(y)).backward()
+        assert_close(g, t.grad.numpy())
+
+
+class TestBCE:
+    def test_matches_torch(self):
+        p = RS.rand(5, 2).astype(np.float32)
+        y = RS.randint(0, 2, (5, 2)).astype(np.float32)
+        c = nn.BCECriterion()
+        assert_close(c.forward(jnp.asarray(p), jnp.asarray(y)),
+                     F.binary_cross_entropy(torch.from_numpy(p),
+                                            torch.from_numpy(y)).item(),
+                     tol=1e-3)
+
+
+class TestAbsSmoothL1:
+    def test_abs(self):
+        x = RS.randn(4, 3).astype(np.float32)
+        y = RS.randn(4, 3).astype(np.float32)
+        assert_close(nn.AbsCriterion().forward(jnp.asarray(x), jnp.asarray(y)),
+                     F.l1_loss(torch.from_numpy(x), torch.from_numpy(y)).item())
+
+    def test_smooth_l1(self):
+        x = RS.randn(4, 3).astype(np.float32)
+        y = RS.randn(4, 3).astype(np.float32)
+        assert_close(nn.SmoothL1Criterion().forward(jnp.asarray(x),
+                                                    jnp.asarray(y)),
+                     F.smooth_l1_loss(torch.from_numpy(x),
+                                      torch.from_numpy(y)).item())
+
+
+class TestDistKLDiv:
+    def test_matches_torch(self):
+        x = np.asarray(jax.nn.log_softmax(jnp.asarray(logits)))
+        t = np.asarray(jax.nn.softmax(jnp.asarray(RS.randn(6, 5)
+                                                  .astype(np.float32))))
+        c = nn.DistKLDivCriterion()
+        ref = F.kl_div(torch.from_numpy(x), torch.from_numpy(t),
+                       reduction="batchmean")
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(t)), ref.item(),
+                     tol=1e-3)
+
+
+class TestMargin:
+    def test_margin(self):
+        x = RS.randn(8).astype(np.float32)
+        y = np.sign(RS.randn(8)).astype(np.float32)
+        ours = nn.MarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+        ref = F.hinge_embedding_loss  # not the same; compute manually
+        expected = np.maximum(0, 1 - x * y).mean()
+        assert_close(ours, expected)
+
+    def test_multi_margin(self):
+        c = nn.MultiMarginCriterion()
+        loss = c.forward(jnp.asarray(logits), jnp.asarray(labels1))
+        ref = F.multi_margin_loss(torch.from_numpy(logits),
+                                  torch.from_numpy(labels1 - 1))
+        assert_close(loss, ref.item())
+
+    def test_multilabel_soft_margin(self):
+        x = RS.randn(4, 5).astype(np.float32)
+        y = RS.randint(0, 2, (4, 5)).astype(np.float32)
+        c = nn.MultiLabelSoftMarginCriterion()
+        ref = F.multilabel_soft_margin_loss(torch.from_numpy(x),
+                                            torch.from_numpy(y))
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(y)), ref.item(),
+                     tol=1e-3)
+
+    def test_soft_margin(self):
+        x = RS.randn(6).astype(np.float32)
+        y = np.sign(RS.randn(6)).astype(np.float32)
+        c = nn.SoftMarginCriterion()
+        ref = F.soft_margin_loss(torch.from_numpy(x), torch.from_numpy(y))
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(y)), ref.item())
+
+    def test_margin_ranking(self):
+        a = RS.randn(5).astype(np.float32)
+        b = RS.randn(5).astype(np.float32)
+        y = np.sign(RS.randn(5)).astype(np.float32)
+        c = nn.MarginRankingCriterion(margin=0.5)
+        ref = F.margin_ranking_loss(torch.from_numpy(a), torch.from_numpy(b),
+                                    torch.from_numpy(y), margin=0.5)
+        assert_close(c.forward((jnp.asarray(a), jnp.asarray(b)),
+                               jnp.asarray(y)), ref.item())
+
+    def test_hinge_embedding(self):
+        x = RS.randn(6).astype(np.float32)
+        y = np.sign(RS.randn(6)).astype(np.float32)
+        c = nn.HingeEmbeddingCriterion()
+        ref = F.hinge_embedding_loss(torch.from_numpy(x),
+                                     torch.from_numpy(y))
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(y)), ref.item())
+
+    def test_cosine_embedding(self):
+        a = RS.randn(4, 6).astype(np.float32)
+        b = RS.randn(4, 6).astype(np.float32)
+        y = np.sign(RS.randn(4)).astype(np.float32)
+        c = nn.CosineEmbeddingCriterion(margin=0.2)
+        ref = F.cosine_embedding_loss(torch.from_numpy(a),
+                                      torch.from_numpy(b),
+                                      torch.from_numpy(y), margin=0.2)
+        assert_close(c.forward((jnp.asarray(a), jnp.asarray(b)),
+                               jnp.asarray(y)), ref.item())
+
+    def test_multilabel_margin(self):
+        x = RS.randn(3, 5).astype(np.float32)
+        t = np.zeros((3, 5), np.int64)
+        t[0, :2] = [2, 4]
+        t[1, :1] = [1]
+        t[2, :3] = [5, 3, 1]
+        c = nn.MultiLabelMarginCriterion()
+        ref = F.multilabel_margin_loss(torch.from_numpy(x),
+                                       torch.from_numpy(t - 1))
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(t)), ref.item(),
+                     tol=1e-3)
+
+
+class TestComposite:
+    def test_multi_criterion(self):
+        x = RS.randn(4, 3).astype(np.float32)
+        y = RS.randn(4, 3).astype(np.float32)
+        mc = nn.MultiCriterion().add(nn.MSECriterion(), 0.5) \
+                                .add(nn.AbsCriterion(), 2.0)
+        expected = 0.5 * nn.MSECriterion().forward(jnp.asarray(x),
+                                                   jnp.asarray(y)) + \
+            2.0 * nn.AbsCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+        assert_close(mc.forward(jnp.asarray(x), jnp.asarray(y)), expected)
+
+    def test_parallel_criterion(self):
+        x1 = RS.randn(4, 3).astype(np.float32)
+        y1 = RS.randn(4, 3).astype(np.float32)
+        pc = nn.ParallelCriterion().add(nn.MSECriterion()) \
+                                   .add(nn.AbsCriterion(), 0.1)
+        loss = pc.forward((jnp.asarray(x1), jnp.asarray(x1)),
+                          (jnp.asarray(y1), jnp.asarray(y1)))
+        expected = nn.MSECriterion().forward(jnp.asarray(x1),
+                                             jnp.asarray(y1)) + \
+            0.1 * nn.AbsCriterion().forward(jnp.asarray(x1), jnp.asarray(y1))
+        assert_close(loss, expected)
+
+    def test_time_distributed(self):
+        x = RS.randn(2, 3, 4).astype(np.float32)
+        y = RS.randn(2, 3, 4).astype(np.float32)
+        c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+        manual = np.mean([float(nn.MSECriterion().forward(
+            jnp.asarray(x[:, t]), jnp.asarray(y[:, t]))) for t in range(3)])
+        assert_close(c.forward(jnp.asarray(x), jnp.asarray(y)), manual)
+
+    def test_l1_penalty_and_cost(self):
+        x = RS.randn(3, 3).astype(np.float32)
+        assert_close(nn.L1Cost().forward(jnp.asarray(x), None),
+                     np.abs(x).sum())
+        m = nn.L1Penalty(0.1)
+        g = m.backward(jnp.asarray(x), jnp.ones((3, 3)))
+        assert_close(g, 1.0 + 0.1 * np.sign(x))
