@@ -1,0 +1,9 @@
+"""Composable data pipelines (reference: dl/.../bigdl/dataset/)."""
+
+from bigdl_tpu.dataset.sample import (Sample, MiniBatch, ByteRecord,
+                                      LabeledSentence)
+from bigdl_tpu.dataset.transformer import (Transformer, ChainedTransformer,
+                                           SampleToBatch)
+from bigdl_tpu.dataset.dataset import (AbstractDataSet, LocalArrayDataSet,
+                                       ShardedDataSet, DataSet, array,
+                                       iterator_source)

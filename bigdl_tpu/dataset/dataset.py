@@ -1,0 +1,218 @@
+"""DataSet abstractions and factories.
+
+Reference parity: AbstractDataSet / LocalDataSet / LocalArrayDataSet /
+DistributedDataSet / CachedDistriDataSet (dataset/DataSet.scala:46-259) and
+the ``DataSet`` factory object (:264-456).
+
+TPU-first: the reference's DistributedDataSet is an RDD cached per Spark
+executor with locality-zipped model partitions; here a ``ShardedDataSet``
+splits the sample stream across mesh data-parallel shards per host process
+(``process_index``/``process_count``) — the same per-worker-cache semantics
+without a cluster framework. Global batches are assembled per step and laid
+out for ``jax.make_array_from_process_local_data`` by the distributed
+optimizer.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random import RandomGenerator
+
+__all__ = ["AbstractDataSet", "LocalArrayDataSet", "ShardedDataSet",
+           "DataSet", "array", "iterator_source"]
+
+
+class AbstractDataSet:
+    """(reference DataSet.scala:46-104)"""
+
+    def data(self, train: bool) -> Iterator:
+        """Endless looped iterator when ``train`` (reference semantics);
+        single pass otherwise."""
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        """(reference ``transform``/``->``)"""
+        return TransformedDataSet(self, transformer)
+
+    def is_sharded(self) -> bool:
+        """True when this dataset (or its base, through transforms) is a
+        data-parallel ShardedDataSet — drives Optimizer factory dispatch."""
+        return False
+
+    def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
+        return self.transform(transformer)
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def data(self, train: bool):
+        return self.transformer(self.base.data(train))
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+
+    def is_sharded(self):
+        return self.base.is_sharded()
+
+    def local_size(self):
+        base_local = getattr(self.base, "local_size", self.base.size)
+        return base_local()
+
+
+class LocalArrayDataSet(AbstractDataSet):
+    """Array-backed local dataset (reference DataSet.scala:110-156):
+    training iterator loops endlessly over a shuffled index array."""
+
+    def __init__(self, data: Sequence):
+        self._data = list(data)
+        self._index = np.arange(len(self._data))
+
+    def data(self, train: bool):
+        if train:
+            if not self._data:
+                raise ValueError("cannot build a training iterator over an "
+                                 "empty dataset")
+            def endless():
+                while True:
+                    for i in self._index:
+                        yield self._data[i]
+            return endless()
+        return iter([self._data[i] for i in self._index])
+
+    def size(self):
+        return len(self._data)
+
+    def shuffle(self):
+        """(reference shuffle: re-randomize the index array)"""
+        RandomGenerator.RNG().shuffle(self._index)
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Data-parallel sharded dataset (replaces the reference's
+    CachedDistriDataSet, DataSet.scala:163-259).
+
+    Each host process keeps the shard ``process_index`` of ``num_shards``;
+    training iterators loop endlessly from a random offset per epoch like
+    the reference (:216-247).
+    """
+
+    def __init__(self, data: Sequence, num_shards: int = 1,
+                 shard_index: int = 0):
+        self._all = list(data)
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self._local = self._all[shard_index::num_shards]
+        self._index = np.arange(len(self._local))
+
+    def is_sharded(self):
+        return True
+
+    def data(self, train: bool):
+        if train:
+            if not self._local:
+                raise ValueError(
+                    f"shard {self.shard_index}/{self.num_shards} is empty — "
+                    "fewer samples than shards")
+            def endless():
+                rng = RandomGenerator.RNG()
+                while True:
+                    offset = int(rng.random_int(0, max(len(self._index), 1)))
+                    order = np.roll(self._index, -offset)
+                    for i in order:
+                        yield self._local[i]
+            return endless()
+        return iter([self._local[i] for i in self._index])
+
+    def size(self):
+        """Global size (reference DistributedDataSet.size counts all)."""
+        return len(self._all)
+
+    def local_size(self) -> int:
+        return len(self._local)
+
+    def shuffle(self):
+        RandomGenerator.RNG().shuffle(self._index)
+
+
+class _BatchIterable(AbstractDataSet):
+    """Wrap an iterable of MiniBatch (pre-batched source)."""
+
+    def __init__(self, make_iter, size):
+        self._make_iter = make_iter
+        self._size = size
+
+    def data(self, train: bool):
+        if train:
+            if self._size <= 0:
+                raise ValueError("cannot build a training iterator over an "
+                                 "empty source")
+            def endless():
+                while True:
+                    yielded = False
+                    for item in self._make_iter():
+                        yielded = True
+                        yield item
+                    if not yielded:
+                        raise ValueError("source iterator yielded nothing")
+            return endless()
+        return self._make_iter()
+
+    def size(self):
+        return self._size
+
+    def shuffle(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Factories (reference DataSet object, DataSet.scala:264-456)
+# ---------------------------------------------------------------------------
+
+def array(data: Sequence, num_shards: int | None = None,
+          shard_index: int = 0) -> AbstractDataSet:
+    """Local or sharded dataset from an in-memory array
+    (reference DataSet.array, :281-294 — distributed when a SparkContext
+    is passed; here when ``num_shards`` is given)."""
+    if num_shards is None:
+        return LocalArrayDataSet(data)
+    return ShardedDataSet(data, num_shards, shard_index)
+
+
+def iterator_source(make_iter, size: int) -> AbstractDataSet:
+    """Dataset from a re-creatable iterator factory (covers the
+    reference's ``DataSet.rdd`` ingestion role for arbitrary sources)."""
+    return _BatchIterable(make_iter, size)
+
+
+class DataSet:
+    """Namespace matching the reference's ``DataSet`` factory object."""
+
+    array = staticmethod(array)
+    iterator = staticmethod(iterator_source)
+
+
+def batches_per_epoch(dataset: AbstractDataSet, batch_size: int) -> int:
+    size = dataset.local_size() if isinstance(dataset, ShardedDataSet) \
+        else dataset.size()
+    return max(1, (size + batch_size - 1) // batch_size)
+
+
+def to_jax_batch(batch: MiniBatch):
+    import jax.numpy as jnp
+    return jnp.asarray(batch.data), jnp.asarray(batch.labels)

@@ -1,0 +1,70 @@
+"""Data carriers.
+
+Reference parity: Sample (dataset/Sample.scala:32-102), MiniBatch /
+ByteRecord / Image / Sentence / Label (dataset/Types.scala:26-81).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+__all__ = ["Sample", "MiniBatch", "ByteRecord", "LabeledSentence"]
+
+
+class Sample:
+    """One (feature, label) pair (reference dataset/Sample.scala)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label):
+        self.feature = np.asarray(feature)
+        self.label = np.asarray(label)
+
+    @staticmethod
+    def from_ndarray(feature, label) -> "Sample":
+        """(python reference util/common.py:59-98 Sample.from_ndarray)"""
+        return Sample(feature, label)
+
+    def clone(self) -> "Sample":
+        return Sample(self.feature.copy(), self.label.copy())
+
+    def __repr__(self):
+        return f"Sample(feature={self.feature.shape}, " \
+               f"label={self.label.shape})"
+
+
+class MiniBatch:
+    """One training batch (reference dataset/Types.scala:73)."""
+
+    __slots__ = ("data", "labels")
+
+    def __init__(self, data, labels):
+        self.data = data
+        self.labels = labels
+
+    def size(self) -> int:
+        return int(np.asarray(self.data).shape[0])
+
+    def narrow(self, offset: int, length: int) -> "MiniBatch":
+        return MiniBatch(self.data[offset:offset + length],
+                         self.labels[offset:offset + length])
+
+    def __iter__(self):  # destructuring: data, labels = batch
+        yield self.data
+        yield self.labels
+
+
+@dataclass
+class ByteRecord:
+    """Raw bytes + label (reference dataset/Types.scala ByteRecord)."""
+    data: bytes
+    label: float
+
+
+@dataclass
+class LabeledSentence:
+    """(reference dataset/text/Types.scala)"""
+    data: Any
+    label: Any

@@ -1,0 +1,97 @@
+"""Composable data transformers.
+
+Reference parity: Transformer[A,B] (dataset/Transformer.scala:39-61) — a
+serializable ``Iterator[A] -> Iterator[B]`` with ``->`` chaining — and
+SampleToBatch (:98-240) with optional feature/label padding to a fixed
+length (RNN support).
+
+Here ``Transformer`` is a callable over iterators; chain with ``>>`` (the
+Python rendering of the reference's ``->``) or ``.then()``.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Iterator
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample, MiniBatch
+
+__all__ = ["Transformer", "ChainedTransformer", "SampleToBatch"]
+
+
+class Transformer:
+    """Iterator[A] -> Iterator[B] (reference Transformer.scala:39-54)."""
+
+    def __call__(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def then(self, other: "Transformer") -> "ChainedTransformer":
+        """(reference ``->`` composition)"""
+        return ChainedTransformer(self, other)
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        return self.then(other)
+
+    def clone_transformer(self) -> "Transformer":
+        """(reference cloneTransformer — used to give each worker its own
+        stateful copy)"""
+        return copy.deepcopy(self)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first: Transformer, last: Transformer):
+        self.first, self.last = first, last
+
+    def __call__(self, it):
+        return self.last(self.first(it))
+
+
+class SampleToBatch(Transformer):
+    """Group Samples into MiniBatches (reference Transformer.scala:98-240).
+
+    ``fixed_length``/``pad_value`` pad variable-length features and labels
+    (the reference's padding branch for RNN pipelines); without them shapes
+    must agree. Partial trailing batches are emitted (matching the
+    reference's behavior when the iterator is exhausted); training datasets
+    loop endlessly so only eval sees a short batch.
+    """
+
+    def __init__(self, batch_size: int, fixed_length: int | None = None,
+                 pad_feature_value: float = 0.0,
+                 pad_label_value: float = 0.0,
+                 drop_remainder: bool = False):
+        self.batch_size = batch_size
+        self.fixed_length = fixed_length
+        self.pad_feature_value = pad_feature_value
+        self.pad_label_value = pad_label_value
+        self.drop_remainder = drop_remainder
+
+    def _pad(self, arr: np.ndarray, value: float) -> np.ndarray:
+        if self.fixed_length is None or arr.shape[0] >= self.fixed_length:
+            return arr[:self.fixed_length] if self.fixed_length else arr
+        pad = [(0, self.fixed_length - arr.shape[0])] + \
+              [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, pad, constant_values=value)
+
+    def __call__(self, it):
+        feats, labels = [], []
+        for s in it:
+            feats.append(self._pad(np.asarray(s.feature),
+                                   self.pad_feature_value))
+            labels.append(self._pad(np.atleast_1d(np.asarray(s.label)),
+                                    self.pad_label_value))
+            if len(feats) == self.batch_size:
+                yield MiniBatch(np.stack(feats), self._stack_labels(labels))
+                feats, labels = [], []
+        if feats and not self.drop_remainder:
+            yield MiniBatch(np.stack(feats), self._stack_labels(labels))
+
+    @staticmethod
+    def _stack_labels(labels):
+        lab = np.stack(labels)
+        # scalar labels arrive as (B, 1) — flatten ONLY that axis, never the
+        # batch axis (np.squeeze() would collapse batch-size-1 batches)
+        if lab.ndim == 2 and lab.shape[1] == 1:
+            lab = lab[:, 0]
+        return lab

@@ -75,6 +75,10 @@ class SpatialConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:  # reference accepts 3-D (C,H,W) input
             x = x[None]
+        if not self.propagate_back:
+            # cut d loss / d input at this layer (reference
+            # SpatialConvolution propagateBack=false)
+            x = jax.lax.stop_gradient(x)
         w = params["weight"].astype(compute_dtype())
         y = jax.lax.conv_general_dilated(
             x.astype(compute_dtype()), w,
@@ -85,8 +89,6 @@ class SpatialConvolution(Module):
         if self.with_bias:
             y = y + params["bias"].astype(compute_dtype())[None, :, None, None]
         y = y.astype(params["weight"].dtype)
-        if not self.propagate_back:
-            x_stopped = True  # gradient wrt input cut below
         if squeeze:
             y = y[0]
         return y, state
@@ -179,7 +181,12 @@ class SpatialFullConvolution(Module):
         # transposed conv = lhs-dilated conv with flipped kernel
         w = params["weight"].astype(compute_dtype())  # (I, O/g, kh, kw)
         w = jnp.flip(w, axis=(-1, -2))
-        w = jnp.swapaxes(w, 0, 1)  # (O/g, I, kh, kw) -> OIHW with I grouped
+        # regroup (I, O/g) -> OIHW (O, I/g) keeping group blocks aligned
+        g = self.n_group
+        I, Og, kh, kw = w.shape
+        w = w.reshape(g, I // g, Og, kh, kw)
+        w = jnp.swapaxes(w, 1, 2)  # (g, O/g, I/g, kh, kw)
+        w = w.reshape(g * Og, I // g, kh, kw)
         pad_h = self.kh - 1 - self.ph
         pad_w = self.kw - 1 - self.pw
         y = jax.lax.conv_general_dilated(

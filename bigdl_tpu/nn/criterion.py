@@ -123,15 +123,14 @@ class ClassSimplexCriterion(Criterion):
 
     @staticmethod
     def _regular_simplex(n):
+        """n unit vertices in R^n with pairwise dot -1/(n-1) — the regular
+        simplex the reference embeds classes into."""
         a = np.zeros((n, n), np.float32)
-        np.fill_diagonal(a, 1.0)
-        # Gram-Schmidt style construction as in the reference
-        for i in range(n):
-            for j in range(i):
-                a[i] -= np.dot(a[i], a[j]) * a[j]
-            norm = np.linalg.norm(a[i])
-            if norm > 0:
-                a[i] /= norm
+        for k in range(n - 1):
+            a[k, k] = np.sqrt(max(1.0 - np.sum(a[k, :k] ** 2), 0.0))
+            for j in range(k + 1, n):
+                a[j, k] = (-1.0 / (n - 1) - np.dot(a[j, :k], a[k, :k])) \
+                    / a[k, k]
         return a
 
     def apply(self, x, target):

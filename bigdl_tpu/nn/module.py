@@ -95,6 +95,7 @@ class Module:
         t0 = time.perf_counter()
         if rng is None and self._rng is not None:
             self._rng, rng = jax.random.split(self._rng)
+        self._forward_rng = rng  # reused by backward for identical masks
         self.output, self.state = self.apply(
             self.params, self.state, x, training=self.training_mode, rng=rng)
         self.forward_time += time.perf_counter() - t0
@@ -107,8 +108,12 @@ class Module:
 
         Computes grad wrt input (returned, like ``updateGradInput``) and
         *accumulates* parameter grads (like ``accGradParameters``).
+        Stochastic layers (Dropout/RReLU) replay the SAME rng the preceding
+        ``forward`` consumed so masks match between passes.
         """
         self.materialize()
+        if rng is None:
+            rng = getattr(self, "_forward_rng", None)
         t0 = time.perf_counter()
 
         def f(params, inp):
@@ -151,6 +156,18 @@ class Module:
         if self.grad_params is None or jax.tree.structure(
                 self.grad_params) != jax.tree.structure(params):
             self.grad_params = jax.tree.map(jnp.zeros_like, params)
+        return self
+
+    def sync(self, params, state=None):
+        """Point this module (and any children) at new params/state trees.
+
+        Training loops donate the old parameter buffers to the jitted step
+        (XLA updates weights in place in HBM); this rebinds the module
+        facade to the live arrays afterwards.
+        """
+        self.params = params
+        if state is not None:
+            self.state = state
         return self
 
     def zero_grad_parameters(self):
@@ -264,6 +281,13 @@ class Container(Module):
         for m in self.modules:
             out.update(m.get_parameters_table())
         return out
+
+    def sync(self, params, state=None):
+        super().sync(params, state)
+        for i, m in enumerate(self.modules):
+            m.sync(params[str(i)],
+                   None if state is None else state[str(i)])
+        return self
 
     def materialize(self, rng=None):
         # keep child facades usable on their own AND consistent with ours

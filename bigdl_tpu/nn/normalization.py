@@ -55,7 +55,7 @@ class BatchNormalization(Module):
         return tuple(i for i in range(x.ndim) if i != 1)
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        squeeze = x.ndim == self.n_dim + 1
+        squeeze = x.ndim == self.n_dim - 1  # unbatched input
         if squeeze:
             x = x[None]
         axes = self._reduce_axes(x)

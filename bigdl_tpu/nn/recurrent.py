@@ -192,8 +192,7 @@ class Recurrent(Container):
                 out = jnp.where(active, out, jnp.zeros_like(out))
             return (h_new, t + 1), out
 
-        (h_final, _), outs = jax.lax.scan(step, (h0, jnp.int32(0)), xs)
-        self._last_hidden = h_final
+        (_, _), outs = jax.lax.scan(step, (h0, jnp.int32(0)), xs)
         return jnp.swapaxes(outs, 0, 1), state
 
 
@@ -205,13 +204,34 @@ class BiRecurrent(Container):
         super().__init__(Recurrent(fwd_cell), Recurrent(bwd_cell))
         self.merge = merge
 
+    @staticmethod
+    def _reverse_padded(x, lengths):
+        """Reverse each sequence within its own length, keeping padding at
+        the tail (so the backward pass starts at each sequence's true end)."""
+        T = x.shape[1]
+        t = jnp.arange(T)[None, :]
+        rev_idx = jnp.where(t < lengths[:, None],
+                            lengths[:, None] - 1 - t, t)
+        return jnp.take_along_axis(
+            x, rev_idx[..., None].astype(jnp.int32), axis=1)
+
     def apply(self, params, state, x, *, training=False, rng=None):
-        fwd, _ = self.modules[0].apply(params["0"], state["0"], x,
+        lengths = None
+        if isinstance(x, (tuple, list)):
+            x, lengths = x
+        fwd_in = x if lengths is None else (x, lengths)
+        fwd, _ = self.modules[0].apply(params["0"], state["0"], fwd_in,
                                        training=training, rng=_fold(rng, 0))
-        rev_in = jnp.flip(x, axis=1)
+        if lengths is None:
+            rev_in = jnp.flip(x, axis=1)
+        else:
+            rev_in = (self._reverse_padded(x, lengths), lengths)
         bwd, _ = self.modules[1].apply(params["1"], state["1"], rev_in,
                                        training=training, rng=_fold(rng, 1))
-        bwd = jnp.flip(bwd, axis=1)
+        if lengths is None:
+            bwd = jnp.flip(bwd, axis=1)
+        else:
+            bwd = self._reverse_padded(bwd, lengths)
         if self.merge == "concat":
             return jnp.concatenate([fwd, bwd], axis=-1), state
         return fwd + bwd, state

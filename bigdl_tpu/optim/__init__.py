@@ -1,0 +1,15 @@
+"""Training loops, optim methods, triggers, validation (reference:
+dl/.../bigdl/optim/)."""
+
+from bigdl_tpu.optim.optim_method import OptimMethod, Adagrad, LBFGS
+from bigdl_tpu.optim.sgd import (SGD, Default, Step, EpochStep, EpochDecay,
+                                 Poly, Regime, EpochSchedule)
+from bigdl_tpu.optim.trigger import (Trigger, every_epoch, several_iteration,
+                                     max_epoch, max_iteration, min_loss,
+                                     or_trigger, and_trigger)
+from bigdl_tpu.optim.validation import (ValidationMethod, ValidationResult,
+                                        AccuracyResult, LossResult,
+                                        Top1Accuracy, Top5Accuracy, Loss)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
+from bigdl_tpu.optim.validator import Validator, LocalValidator

@@ -1,0 +1,55 @@
+"""Named training metrics.
+
+Reference parity: optim/Metrics.scala:24-117 — named counters in local /
+aggregate / per-node-distributed scopes, dumped via ``summary()``. The Spark
+accumulator scopes collapse to host-side counters here (one process per
+host in the TPU runtime); per-phase timings are set each iteration by the
+optimizers, mirroring DistriOptimizer.scala:113-117.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+__all__ = ["Metrics"]
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scalars: dict[str, float] = {}
+        self._counts: dict[str, int] = defaultdict(int)
+        self._distributed: dict[str, list] = {}
+
+    def set(self, name: str, value: float, parallel: int = 1):
+        """(reference Metrics.set)"""
+        with self._lock:
+            self._scalars[name] = float(value) / parallel
+
+    def add(self, name: str, value: float):
+        """(reference Metrics.add on accumulators)"""
+        with self._lock:
+            self._scalars[name] = self._scalars.get(name, 0.0) + float(value)
+            self._counts[name] += 1
+
+    def set_distributed(self, name: str, values):
+        with self._lock:
+            self._distributed[name] = list(values)
+
+    def get(self, name: str) -> float:
+        return self._scalars.get(name, 0.0)
+
+    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
+        """(reference Metrics.summary, Metrics.scala:96-108)"""
+        with self._lock:
+            lines = ["========== Metrics Summary =========="]
+            for k in sorted(self._scalars):
+                # add()-accumulated metrics report their mean, matching the
+                # reference's aggregated-accumulator summary
+                # (Metrics.scala:96-108)
+                denom = max(self._counts.get(k, 0), 1) * scale
+                lines.append(f"{k} : {self._scalars[k] / denom} {unit}")
+            for k in sorted(self._distributed):
+                lines.append(f"{k} : {self._distributed[k]}")
+            lines.append("=====================================")
+            return "\n".join(lines)

@@ -1,0 +1,201 @@
+"""Optimization-method protocol + Adagrad + LBFGS.
+
+Reference parity: OptimMethod (optim/OptimMethod.scala:25-70 — Torch-style
+``optimize(feval, x, config, state)``), Adagrad (optim/Adagrad.scala),
+LBFGS + lswolfe LineSearch (optim/LBFGS.scala, LineSearch.scala).
+
+TPU-first protocol: ``init_state(params)`` + pure ``update(grads, params,
+state) -> (params, state)`` over pytrees, compiled into the train step. The
+Torch-style ``optimize(feval, x)`` facade is kept for LBFGS-style full-batch
+use and reference-API parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["OptimMethod", "Adagrad", "LBFGS"]
+
+
+class OptimMethod:
+    """Base optimizer."""
+
+    def init_state(self, params) -> dict:
+        return {"neval": jnp.zeros((), jnp.int32),
+                "epoch": jnp.ones((), jnp.int32)}
+
+    def update(self, grads, params, state):
+        """Pure pytree update; returns (new_params, new_state)."""
+        raise NotImplementedError
+
+    # Torch-style facade (reference OptimMethod.optimize)
+    def optimize(self, feval, x, state=None):
+        """``feval(x) -> (loss, grad)`` on a flat vector or pytree;
+        performs ONE step; returns (new_x, [loss], state)."""
+        if state is None:
+            state = self.init_state(x)
+        loss, grad = feval(x)
+        new_x, state = self.update(grad, x, state)
+        return new_x, [loss], state
+
+    def clone(self):
+        import copy
+        return copy.deepcopy(self)
+
+
+class Adagrad(OptimMethod):
+    """(reference optim/Adagrad.scala — standard accumulator, eps 1e-10)"""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {"neval": jnp.zeros((), jnp.int32),
+                "epoch": jnp.ones((), jnp.int32),
+                "accum": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        clr = self.learning_rate / (1.0 + state["neval"]
+                                    * self.learning_rate_decay)
+
+        def upd(g, p, a):
+            if self.weight_decay > 0:
+                g = g + self.weight_decay * p
+            a_new = a + jnp.square(g)
+            p_new = p - clr * g / (jnp.sqrt(a_new) + 1e-10)
+            return p_new, a_new
+
+        pairs = jax.tree.map(upd, grads, params, state["accum"])
+        new_params = jax.tree.map(lambda t: t[0], pairs,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        accum = jax.tree.map(lambda t: t[1], pairs,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, dict(state, accum=accum,
+                                neval=state["neval"] + 1)
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with optional Wolfe line search
+    (reference optim/LBFGS.scala:25-286, LineSearch.scala lswolfe).
+
+    Works on the flat parameter vector (the reference requires the
+    flattened ``getParameters()`` view; here ``optimize`` accepts any
+    pytree and flattens internally). Full-batch method: drive it through
+    ``optimize(feval, x)``.
+    """
+
+    def __init__(self, max_iter: int = 20, max_eval: float | None = None,
+                 tolerance_fun: float = 1e-5, tolerance_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: bool = False):
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 1.25
+        self.tolerance_fun = tolerance_fun
+        self.tolerance_x = tolerance_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def optimize(self, feval, x, state=None):
+        from bigdl_tpu.tensor import flatten_params
+        flat0, unravel = flatten_params(x)
+
+        def f(v):
+            loss, g = feval(unravel(v))
+            gflat, _ = flatten_params(g)
+            return jnp.asarray(loss), gflat
+
+        fx, g = f(flat0)
+        losses = [float(fx)]
+        if float(jnp.max(jnp.abs(g))) <= self.tolerance_fun:
+            return x, losses, state or {}
+
+        xk = flat0
+        s_list, y_list, ro_list = [], [], []
+        H_diag = 1.0
+        n_eval = 1
+        g_prev, x_prev = g, xk
+
+        for it in range(self.max_iter):
+            # two-loop recursion
+            q = -g
+            alphas = []
+            for s, y, ro in zip(reversed(s_list), reversed(y_list),
+                                reversed(ro_list)):
+                a = ro * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            q = q * H_diag
+            for (s, y, ro), a in zip(zip(s_list, y_list, ro_list),
+                                     reversed(alphas)):
+                b = ro * jnp.dot(y, q)
+                q = q + s * (a - b)
+            d = q
+
+            gtd = jnp.dot(g, d)
+            if float(gtd) > -self.tolerance_x:
+                break
+            t = self.learning_rate if it > 0 else \
+                min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) \
+                * self.learning_rate
+
+            if self.line_search:
+                t, fx, g, n_ls = self._lswolfe(f, xk, fx, g, d, t)
+                n_eval += n_ls
+                xk = xk + t * d
+            else:
+                xk = xk + t * d
+                fx_new, g_new = f(xk)
+                n_eval += 1
+                fx, g = fx_new, g_new
+            losses.append(float(fx))
+
+            s = xk - x_prev
+            y = g - g_prev
+            ys = jnp.dot(y, s)
+            if float(ys) > 1e-10:
+                if len(s_list) == self.n_correction:
+                    s_list.pop(0)
+                    y_list.pop(0)
+                    ro_list.pop(0)
+                s_list.append(s)
+                y_list.append(y)
+                ro_list.append(1.0 / ys)
+                H_diag = ys / jnp.dot(y, y)
+            x_prev, g_prev = xk, g
+
+            if n_eval >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_fun:
+                break
+            if len(losses) > 1 and abs(losses[-1] - losses[-2]) \
+                    < self.tolerance_fun:
+                break
+
+        return unravel(xk), losses, state or {}
+
+    @staticmethod
+    def _lswolfe(f, x, fx, g, d, t, c1=1e-4, c2=0.9, max_ls=25):
+        """Backtracking Wolfe line search (reference LineSearch.lswolfe)."""
+        gtd = jnp.dot(g, d)
+        fx0, gtd0 = fx, gtd
+        n_eval = 0
+        lo, hi = 0.0, None
+        for _ in range(max_ls):
+            fx_t, g_t = f(x + t * d)
+            n_eval += 1
+            if float(fx_t) > float(fx0 + c1 * t * gtd0):
+                hi = t
+            elif abs(float(jnp.dot(g_t, d))) <= -c2 * float(gtd0):
+                return t, fx_t, g_t, n_eval
+            elif float(jnp.dot(g_t, d)) < 0:
+                lo = t
+            else:
+                hi = t
+            t = (lo + hi) / 2.0 if hi is not None else t * 2.0
+        fx_t, g_t = f(x + t * d)
+        return t, fx_t, g_t, n_eval + 1

@@ -1,0 +1,232 @@
+"""Optimizer facade + LocalOptimizer.
+
+Reference parity: abstract Optimizer (optim/Optimizer.scala:29-128 —
+setValidation / setCheckpoint / setState / setOptimMethod / setEndWhen /
+overWriteCheckpoint), factory dispatch on dataset type (:150-186), and
+LocalOptimizer (optim/LocalOptimizer.scala:39-242).
+
+TPU-first: the reference clones one model per core, shares a flat weight
+storage, runs thread-parallel fwd/bwd and merges gradients chunk-parallel
+(:64-141). All of that collapses into ONE jit-compiled train step — XLA owns
+op parallelism on the chip; there are no replicas to merge. The step fn is
+donated-argument jitted so weights update in place in HBM.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset.dataset import (AbstractDataSet, ShardedDataSet,
+                                       to_jax_batch)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.sgd import SGD
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils.table import Table, T
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+__all__ = ["Optimizer", "LocalOptimizer"]
+
+
+class Optimizer:
+    """Facade + factory (reference optim/Optimizer.scala)."""
+
+    def __new__(cls, model=None, dataset=None, criterion=None,
+                batch_size=None, **kw):
+        if cls is Optimizer:
+            # factory dispatch (reference Optimizer.apply :150-186); the
+            # is_sharded() walk sees through transform wrappers
+            sharded = dataset is not None and hasattr(dataset, "is_sharded") \
+                and dataset.is_sharded()
+            if sharded or kw.get("mesh") is not None:
+                from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+                return super().__new__(DistriOptimizer)
+            return super().__new__(LocalOptimizer)
+        return super().__new__(cls)
+
+    def __init__(self, model, dataset, criterion, batch_size=None, **kw):
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.dataset.sample import Sample
+        self.model = model
+        if batch_size is not None:
+            # RDD[Sample]+batchSize overload (reference :150-162)
+            dataset = dataset >> SampleToBatch(batch_size)
+        self.dataset = dataset
+        self.criterion = criterion
+        self.state = T()
+        self.optim_method: OptimMethod = SGD()
+        self.end_when: Trigger | None = None
+        self.validation_trigger = None
+        self.validation_dataset = None
+        self.validation_methods = None
+        self.checkpoint_trigger = None
+        self.checkpoint_path = None
+        self.is_overwrite = False
+        self.metrics = Metrics()
+
+    # -- builder API (reference Optimizer.scala:66-123) --
+    def set_validation(self, trigger, dataset, methods):
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = list(methods)
+        return self
+
+    def set_checkpoint(self, path, trigger):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def overwrite_checkpoint(self):
+        self.is_overwrite = True
+        return self
+
+    def set_state(self, state):
+        self.state = Table(state)
+        return self
+
+    def set_optim_method(self, method: OptimMethod):
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, end_when: Trigger):
+        self.end_when = end_when
+        return self
+
+    def optimize(self):
+        raise NotImplementedError
+
+    # -- shared helpers --
+    def _header(self, epoch, count, total, neval, wallclock):
+        """(reference Optimizer.header, Optimizer.scala:131-134)"""
+        return f"[Epoch {epoch} {count}/{total}][Iteration {neval}]" \
+               f"[Wall Clock {wallclock:.3f}s]"
+
+    def _validate(self, apply_fn, params, mstate, driver_state):
+        if self.validation_trigger is None or \
+                self.validation_dataset is None:
+            return None
+        if not self.validation_trigger(driver_state):
+            return None
+        results = [None] * len(self.validation_methods)
+        count = 0
+        t0 = time.perf_counter()
+        for batch in self.validation_dataset.data(train=False):
+            data, labels = to_jax_batch(batch)
+            out = apply_fn(params, mstate, data)
+            count += data.shape[0]
+            for i, m in enumerate(self.validation_methods):
+                r = m(out, labels)
+                results[i] = r if results[i] is None else results[i] + r
+        elapsed = time.perf_counter() - t0
+        logger.info(f"validate model throughput is "
+                    f"{count / max(elapsed, 1e-9):.2f} records/second")
+        for m, r in zip(self.validation_methods, results):
+            logger.info(f"{m!r} is {r!r}")
+        return dict(zip([repr(m) for m in self.validation_methods], results))
+
+    def _checkpoint(self, driver_state):
+        if self.checkpoint_trigger is None or self.checkpoint_path is None:
+            return
+        if not self.checkpoint_trigger(driver_state):
+            return
+        from bigdl_tpu.utils import file as _file
+        neval = driver_state["neval"]
+        suffix = "" if self.is_overwrite else f".{neval}"
+        _file.save_module(self.model,
+                          f"{self.checkpoint_path}/model{suffix}",
+                          overwrite=True)
+        _file.save(dict(driver_state),
+                   f"{self.checkpoint_path}/state{suffix}", overwrite=True)
+        logger.info(f"Save model to {self.checkpoint_path}/model{suffix}")
+
+
+class LocalOptimizer(Optimizer):
+    """Single-host training loop (reference optim/LocalOptimizer.scala)."""
+
+    def optimize(self):
+        model, criterion, optim = self.model, self.criterion, \
+            self.optim_method
+        model.materialize()
+        model.training()
+        params, mstate = model.params, model.state
+        opt_state = optim.init_state(params)
+        # resume support (reference: epoch/neval live in the state Table,
+        # DistriOptimizer.scala:80-81)
+        driver_state = {"epoch": int(self.state.get("epoch", 1)),
+                        "neval": int(self.state.get("neval", 1)),
+                        "is_epoch_end": False, "loss": float("inf")}
+        if driver_state["neval"] > 1:
+            opt_state["neval"] = jnp.asarray(driver_state["neval"] - 1,
+                                             jnp.int32)
+
+        def train_step(params, mstate, opt_state, rng, data, labels, epoch):
+            def loss_fn(p):
+                y, new_mstate = model.apply(p, mstate, data, training=True,
+                                            rng=rng)
+                return criterion.apply(y, labels), new_mstate
+
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            opt_state = dict(opt_state, epoch=epoch)
+            new_params, new_opt_state = optim.update(grads, params,
+                                                     opt_state)
+            return new_params, new_mstate, new_opt_state, loss
+
+        jit_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+        def eval_apply(params, mstate, data):
+            out, _ = model.apply(params, mstate, data, training=False)
+            return out
+
+        jit_eval = jax.jit(eval_apply)
+
+        rng = jax.random.PRNGKey(int(self.state.get("seed", 0)))
+        data_iter = self.dataset.data(train=True)
+        epoch_size = self.dataset.size()
+        count_this_epoch = int(self.state.get("record_count", 0))
+        wallclock_start = time.perf_counter()
+
+        while self.end_when is None or not self.end_when(driver_state):
+            driver_state["is_epoch_end"] = False
+            t0 = time.perf_counter()
+            batch = next(data_iter)
+            data, labels = to_jax_batch(batch)
+            data_time = time.perf_counter() - t0
+            rng, step_rng = jax.random.split(rng)
+            params, mstate, opt_state, loss = jit_step(
+                params, mstate, opt_state, step_rng, data, labels,
+                jnp.asarray(driver_state["epoch"], jnp.int32))
+            loss = float(loss)  # blocks; keeps host loop in lockstep
+            step_time = time.perf_counter() - t0
+            n = int(data.shape[0])
+            count_this_epoch += n
+            driver_state["loss"] = loss
+            wallclock = time.perf_counter() - wallclock_start
+            logger.info(
+                self._header(driver_state["epoch"], count_this_epoch,
+                             epoch_size, driver_state["neval"], wallclock)
+                + f" loss is {loss:.6f}, iteration time is {step_time:.4f}s,"
+                f" data fetch time is {data_time:.4f}s, "
+                f"throughput is {n / max(step_time, 1e-9):.2f} records/second")
+            self.metrics.set("computing time for each iteration", step_time)
+            self.metrics.set("data fetch time", data_time)
+            driver_state["neval"] += 1
+            if count_this_epoch >= epoch_size:
+                driver_state["epoch"] += 1
+                driver_state["is_epoch_end"] = True
+                count_this_epoch = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+            # publish params for validation/checkpoint (rebinds children
+            # too — the old buffers were donated to the jitted step)
+            model.sync(params, mstate)
+            self._validate(jit_eval, params, mstate, driver_state)
+            self._checkpoint(driver_state)
+
+        model.sync(params, mstate)
+        model.evaluate()
+        return model

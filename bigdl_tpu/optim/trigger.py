@@ -1,0 +1,59 @@
+"""Triggers — predicates over the training state.
+
+Reference parity: optim/Trigger.scala:21-70 — ``everyEpoch``,
+``severalIteration(n)``, ``maxEpoch(n)``, ``maxIteration(n)``.
+State keys follow the reference's state Table: ``neval`` (iteration count),
+``epoch``, plus ``is_epoch_end`` maintained by the optimizers.
+"""
+from __future__ import annotations
+
+__all__ = ["Trigger", "every_epoch", "several_iteration", "max_epoch",
+           "max_iteration", "min_loss", "or_trigger", "and_trigger"]
+
+
+class Trigger:
+    def __init__(self, fn, desc=""):
+        self._fn = fn
+        self._desc = desc
+
+    def __call__(self, state) -> bool:
+        return bool(self._fn(state))
+
+    def __repr__(self):
+        return f"Trigger({self._desc})"
+
+
+def every_epoch() -> Trigger:
+    """Fires at each epoch boundary (reference Trigger.everyEpoch —
+    implemented there with a cached epoch counter; here the optimizers set
+    ``is_epoch_end``)."""
+    return Trigger(lambda s: s.get("is_epoch_end", False), "everyEpoch")
+
+
+def several_iteration(interval: int) -> Trigger:
+    """(reference Trigger.severalIteration)"""
+    return Trigger(lambda s: s["neval"] % interval == 0,
+                   f"severalIteration({interval})")
+
+
+def max_epoch(n: int) -> Trigger:
+    """(reference Trigger.maxEpoch)"""
+    return Trigger(lambda s: s["epoch"] > n, f"maxEpoch({n})")
+
+
+def max_iteration(n: int) -> Trigger:
+    """(reference Trigger.maxIteration)"""
+    return Trigger(lambda s: s["neval"] > n, f"maxIteration({n})")
+
+
+def min_loss(value: float) -> Trigger:
+    return Trigger(lambda s: s.get("loss", float("inf")) < value,
+                   f"minLoss({value})")
+
+
+def or_trigger(*triggers: Trigger) -> Trigger:
+    return Trigger(lambda s: any(t(s) for t in triggers), "or")
+
+
+def and_trigger(*triggers: Trigger) -> Trigger:
+    return Trigger(lambda s: all(t(s) for t in triggers), "and")

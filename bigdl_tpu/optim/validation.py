@@ -1,0 +1,112 @@
+"""Validation methods and addable results.
+
+Reference parity: optim/ValidationMethod.scala:26-219 — Top1Accuracy,
+Top5Accuracy, Loss; results are monoids combined across cores/partitions
+(here: across batches/devices).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ValidationResult", "AccuracyResult", "LossResult",
+           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss"]
+
+
+class ValidationResult:
+    def result(self) -> tuple[float, int]:
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    """(correct, count) monoid (reference ValidationMethod.scala:29-56)."""
+
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __eq__(self, other):
+        return (self.correct, self.count) == (other.correct, other.count)
+
+    def __repr__(self):
+        acc, cnt = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {cnt}, " \
+               f"accuracy: {acc})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = float(loss), int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        mean, cnt = self.result()
+        return f"Loss(loss: {self.loss}, count: {cnt}, mean: {mean})"
+
+
+class ValidationMethod:
+    """output x target -> ValidationResult."""
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+
+class Top1Accuracy(ValidationMethod):
+    """(reference ValidationMethod.scala:90-123; targets 1-based)"""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if out.ndim == 1:
+            out = out[None]
+        pred = out.argmax(axis=-1) + 1
+        return AccuracyResult(int((pred == t).sum()), t.shape[0])
+
+    def __repr__(self):
+        return "Top1Accuracy"
+
+
+class Top5Accuracy(ValidationMethod):
+    """(reference ValidationMethod.scala:125-163)"""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if out.ndim == 1:
+            out = out[None]
+        top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+        correct = int((top5 == t[:, None]).any(axis=1).sum())
+        return AccuracyResult(correct, t.shape[0])
+
+    def __repr__(self):
+        return "Top5Accuracy"
+
+
+class Loss(ValidationMethod):
+    """Mean criterion loss (reference ValidationMethod.scala:207-219)."""
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def __call__(self, output, target):
+        l = float(self.criterion.apply(jnp.asarray(output),
+                                       jnp.asarray(target)))
+        n = np.asarray(output).shape[0]
+        return LossResult(l * n, n)
+
+    def __repr__(self):
+        return "Loss"

@@ -1,0 +1,48 @@
+"""Standalone model evaluation.
+
+Reference parity: Validator / LocalValidator / DistriValidator
+(optim/Validator.scala:51, LocalValidator.scala, DistriValidator.scala:29-80)
+— broadcast an eval-mode model, map over the validation set, monoid-reduce
+the ValidationResults.
+"""
+from __future__ import annotations
+
+import jax
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, to_jax_batch
+
+__all__ = ["Validator", "LocalValidator"]
+
+
+class LocalValidator:
+    """(reference optim/LocalValidator.scala — per-core clones collapse
+    into one jitted eval fn)"""
+
+    def __init__(self, model, dataset: AbstractDataSet):
+        self.model = model
+        self.dataset = dataset
+
+    def test(self, methods):
+        model = self.model
+        model.materialize()
+        model.evaluate()
+
+        @jax.jit
+        def eval_apply(params, mstate, data):
+            out, _ = model.apply(params, mstate, data, training=False)
+            return out
+
+        results = [None] * len(methods)
+        for batch in self.dataset.data(train=False):
+            data, labels = to_jax_batch(batch)
+            out = eval_apply(model.params, model.state, data)
+            for i, m in enumerate(methods):
+                r = m(out, labels)
+                results[i] = r if results[i] is None else results[i] + r
+        return list(zip(results, methods))
+
+
+def Validator(model, dataset: AbstractDataSet):
+    """Factory (reference optim/Validator.scala:51 — dispatch on dataset
+    type; the sharded eval path reuses LocalValidator per shard)."""
+    return LocalValidator(model, dataset)

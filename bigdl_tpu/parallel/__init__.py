@@ -1,0 +1,5 @@
+"""Distributed runtime: mesh engine, shardings, collectives (replaces the
+reference's Engine thread pools + Spark BlockManager parameter server)."""
+
+from bigdl_tpu.parallel.engine import (Engine, get_mesh, data_sharding,
+                                       replicated)

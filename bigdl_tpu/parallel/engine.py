@@ -1,0 +1,106 @@
+"""Engine — runtime topology initialization.
+
+Reference parity: utils/Engine.scala:206-360. The reference's Engine wires
+JVM thread pools (``Engine.default``/``Engine.model``), reads
+``DL_NODE_NUMBER``/``DL_CORE_NUMBER`` env vars, pins MKL threads and returns
+a SparkConf. On TPU the entire threading runtime disappears (XLA owns op
+parallelism); ``Engine.init`` instead builds the **device mesh** that every
+distributed component shards over — the TPU equivalent of node/core topology:
+
+- ``data`` axis  — data parallelism (the reference's node-level sync SGD)
+- ``model`` axis — tensor parallelism (not in the reference; axis kept open
+  so the mesh design scales beyond it, SURVEY §2.6 scoping note)
+- ``seq`` axis   — sequence/context parallelism for long-context models
+
+Multi-host: one process per host, all devices enumerated by
+``jax.devices()`` — collectives ride ICI within a slice and DCN across
+slices, laid out by XLA from the sharding annotations.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("bigdl_tpu.parallel")
+
+__all__ = ["Engine", "get_mesh", "data_sharding", "replicated"]
+
+_mesh: Mesh | None = None
+
+
+class Engine:
+    """(reference utils/Engine.scala — singleton topology holder)"""
+
+    @staticmethod
+    def init(node_number: int | None = None, core_number: int | None = None,
+             on_spark: bool = False, *, axes: dict | None = None,
+             devices=None) -> Mesh:
+        """Build and install the global device mesh.
+
+        ``node_number``/``core_number`` are accepted for reference-API
+        parity (Engine.init(node, cores, onSpark), Engine.scala:337-348) —
+        their product must match the device count when given. ``axes`` maps
+        axis names to sizes, e.g. ``{"data": 4, "model": 2}``; default is
+        pure data parallelism over every visible device.
+        """
+        global _mesh
+        devs = list(devices if devices is not None else jax.devices())
+        n = len(devs)
+        if axes is None:
+            if node_number is not None:
+                want = node_number * (core_number or 1)
+                if want != n:
+                    logger.warning(
+                        f"Engine.init: node*core = {want} but "
+                        f"{n} devices visible; using {n}")
+            axes = {"data": n}
+        sizes = list(axes.values())
+        assert int(np.prod(sizes)) == n, \
+            f"mesh axes {axes} do not cover {n} devices"
+        mesh_devs = np.asarray(devs).reshape(sizes)
+        _mesh = Mesh(mesh_devs, tuple(axes.keys()))
+        logger.info(f"Engine initialized: mesh {dict(axes)} over {n} "
+                    f"{devs[0].platform} device(s)")
+        return _mesh
+
+    @staticmethod
+    def node_number() -> int:
+        """Data-parallel degree (reference Engine.nodeNumber)."""
+        m = get_mesh()
+        return int(m.shape.get("data", 1))
+
+    @staticmethod
+    def core_number() -> int:
+        """Reference Engine.coreNumber — on TPU each shard is one chip."""
+        return 1
+
+    @staticmethod
+    def is_initialized() -> bool:
+        return _mesh is not None
+
+    @staticmethod
+    def reset() -> None:
+        global _mesh
+        _mesh = None
+
+
+def get_mesh() -> Mesh:
+    if _mesh is None:
+        Engine.init()
+    return _mesh
+
+
+def data_sharding(mesh: Mesh | None = None, *, axis: str = "data"
+                  ) -> NamedSharding:
+    """Batch-axis sharding over the data-parallel mesh axis."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())

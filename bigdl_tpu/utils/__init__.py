@@ -1,0 +1,6 @@
+"""Utilities: Table, checkpoint IO, RNG, interop loaders (reference:
+dl/.../bigdl/utils/)."""
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator
+from bigdl_tpu.utils import file  # noqa: F401

@@ -1,0 +1,56 @@
+"""Table — the universal config/state container.
+
+Reference parity: utils/Table.scala:34-328 and the ``T`` constructor object
+(:285-327) — a Lua-style hybrid map/array used for optimizer config, training
+state and nested activations. Here it is a thin dict subclass with attribute
+access and the reference's 1-based array part; JAX pytrees (tuples/dicts)
+cover the nested-activation role.
+"""
+from __future__ import annotations
+
+__all__ = ["Table", "T"]
+
+
+class Table(dict):
+    """dict with attribute access and 1-based integer array part."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+    def insert(self, value):
+        """Append to the array part (1-based, reference Table.insert)."""
+        i = 1
+        while i in self:
+            i += 1
+        self[i] = value
+        return self
+
+    def length(self) -> int:
+        n = 0
+        while (n + 1) in self:
+            n += 1
+        return n
+
+    def update_with(self, other: dict):
+        self.update(other)
+        return self
+
+    def clone(self) -> "Table":
+        import copy
+        return copy.deepcopy(self)
+
+
+def T(*args, **kwargs) -> Table:
+    """Build a Table: positional args go to the 1-based array part,
+    keyword args to the map part (reference object T, Table.scala:285-327)."""
+    t = Table()
+    for i, a in enumerate(args, start=1):
+        t[i] = a
+    t.update(kwargs)
+    return t

@@ -97,6 +97,25 @@ class TestConv:
                        stride=1, padding=2, dilation=2)
         assert_close(y, t2n(ref), tol=1e-3)
 
+    def test_full_conv_grouped(self):
+        m = nn.SpatialFullConvolution(4, 4, 3, 3, 2, 2, 1, 1, 1, 1,
+                                      n_group=2)
+        m.materialize(jax.random.PRNGKey(0))
+        x = np.random.RandomState(0).randn(2, 4, 7, 7).astype(np.float32)
+        y = run(m, jnp.asarray(x))
+        ref = F.conv_transpose2d(
+            torch.from_numpy(x),
+            torch.from_numpy(np.asarray(m.params["weight"])),
+            torch.from_numpy(np.asarray(m.params["bias"])),
+            stride=2, padding=1, output_padding=1, groups=2)
+        assert_close(y, t2n(ref), tol=1e-3)
+
+    def test_propagate_back_false_cuts_input_grad(self):
+        conv = nn.SpatialConvolution(2, 3, 3, 3, propagate_back=False)
+        conv.materialize(jax.random.PRNGKey(0))
+        gi = conv.backward(jnp.ones((1, 2, 8, 8)), jnp.ones((1, 3, 6, 6)))
+        assert float(jnp.abs(gi).sum()) == 0.0
+
     def test_full_conv_transposed(self):
         m = nn.SpatialFullConvolution(4, 3, 3, 3, 2, 2, 1, 1, 1, 1)
         m.materialize(jax.random.PRNGKey(4))
@@ -134,6 +153,13 @@ class TestPooling:
 
 
 class TestNormalization:
+    def test_batchnorm_unbatched_input(self):
+        bn = nn.SpatialBatchNormalization(4)
+        bn.materialize(jax.random.PRNGKey(0))
+        y, _ = bn.apply(bn.params, bn.state, jnp.ones((4, 5, 5)),
+                        training=False)
+        assert y.shape == (4, 5, 5)
+
     def test_batchnorm_train_and_eval(self):
         m = nn.SpatialBatchNormalization(4)
         m.materialize(jax.random.PRNGKey(5))
@@ -216,6 +242,13 @@ class TestDropout:
         m = nn.Dropout(0.5)
         x = jnp.ones((10, 10))
         assert_close(run(m, x, training=False), np.ones((10, 10)))
+
+    def test_backward_replays_forward_rng(self):
+        seq = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        x = jnp.ones((3, 4))
+        out = seq.forward(x)
+        g = seq.backward(x, jnp.ones_like(out))
+        assert g.shape == x.shape
 
     def test_train_scales(self):
         m = nn.Dropout(0.5)

@@ -108,6 +108,12 @@ class TestWrappers:
         y = m.forward(jnp.ones((3, 5, 4)))
         assert y.shape == (3, 5, 2)
 
+    def test_birecurrent_with_lengths(self):
+        m = nn.BiRecurrent(nn.LSTM(3, 4), nn.LSTM(3, 4))
+        x = jnp.asarray(RS.randn(2, 6, 3).astype(np.float32))
+        y = m.forward((x, jnp.asarray([6, 3])))
+        assert y.shape == (2, 6, 8)
+
     def test_birecurrent(self):
         m = nn.BiRecurrent(nn.LSTM(3, 4), nn.LSTM(3, 4))
         y = m.forward(jnp.asarray(RS.randn(2, 5, 3).astype(np.float32)))
