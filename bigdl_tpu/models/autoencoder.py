@@ -1,0 +1,21 @@
+"""MNIST autoencoder (reference models/autoencoder/Autoencoder.scala)."""
+from __future__ import annotations
+
+from bigdl_tpu.nn import Linear, ReLU, Reshape, Sequential, Sigmoid
+
+__all__ = ["Autoencoder", "ROW_N", "COL_N", "FEATURE_SIZE"]
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def Autoencoder(class_num: int) -> Sequential:
+    """784 -> classNum -> 784 sigmoid reconstruction net
+    (reference Autoencoder.scala:27-35)."""
+    return (Sequential()
+            .add(Reshape((FEATURE_SIZE,)))
+            .add(Linear(FEATURE_SIZE, class_num))
+            .add(ReLU())
+            .add(Linear(class_num, FEATURE_SIZE))
+            .add(Sigmoid()))

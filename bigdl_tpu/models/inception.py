@@ -1,0 +1,407 @@
+"""GoogLeNet Inception v1 / v2 (reference models/inception/).
+
+Reference parity:
+- ``Inception_Layer_v1`` (inception/Inception_v1.scala:24-56): four branches
+  (1x1 / 3x3-reduce+3x3 / 5x5-reduce+5x5 / pool+proj) concatenated on the
+  channel axis, Xavier init, ceil-mode pooling.
+- ``Inception_v1`` with two auxiliary classifier heads whose LogSoftMax
+  outputs concat with the main head (Inception_v1.scala:96-176); training
+  uses a criterion over the (N, 3*classNum) concat.
+- ``Inception_Layer_v2`` (inception/Inception_v2.scala:25-103): BN after
+  every conv, double-3x3 tower instead of 5x5, avg/max pool switch, and
+  downsample blocks (first-branch width 0 → stride-2, no 1x1/pool-proj).
+- ``Inception_v2`` (Inception_v2.scala:151-236).
+
+TPU-first: models are built from the pure-module combinators; one jit of
+``model.apply`` compiles the whole branch-concat graph so XLA fuses the
+reference's hand-threaded Concat copies (nn/Concat.scala:42-80) away.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU,
+                          Sequential, SpatialAveragePooling,
+                          SpatialBatchNormalization, SpatialConvolution,
+                          SpatialCrossMapLRN, SpatialMaxPooling, View)
+from bigdl_tpu.nn import init as init_mod
+
+__all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier",
+           "Inception_Layer_v2", "Inception_v2", "Inception_v2_NoAuxClassifier"]
+
+
+def Inception_Layer_v1(input_size, config, name_prefix=""):
+    """Branch-concat block (reference Inception_v1.scala:24-56).
+
+    ``config`` = ((n1x1,), (n3x3r, n3x3), (n5x5r, n5x5), (npool,)).
+    """
+    concat = Concat(1).set_name(name_prefix + "output")
+    conv1 = (Sequential()
+             .add(SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1,
+                                     init_method=init_mod.Xavier)
+                  .set_name(name_prefix + "1x1"))
+             .add(ReLU().set_name(name_prefix + "relu_1x1")))
+    concat.add(conv1)
+    conv3 = (Sequential()
+             .add(SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1,
+                                     init_method=init_mod.Xavier)
+                  .set_name(name_prefix + "3x3_reduce"))
+             .add(ReLU().set_name(name_prefix + "relu_3x3_reduce"))
+             .add(SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1,
+                                     1, 1, init_method=init_mod.Xavier)
+                  .set_name(name_prefix + "3x3"))
+             .add(ReLU().set_name(name_prefix + "relu_3x3")))
+    concat.add(conv3)
+    conv5 = (Sequential()
+             .add(SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1,
+                                     init_method=init_mod.Xavier)
+                  .set_name(name_prefix + "5x5_reduce"))
+             .add(ReLU().set_name(name_prefix + "relu_5x5_reduce"))
+             .add(SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1,
+                                     2, 2, init_method=init_mod.Xavier)
+                  .set_name(name_prefix + "5x5"))
+             .add(ReLU().set_name(name_prefix + "relu_5x5")))
+    concat.add(conv5)
+    pool = (Sequential()
+            .add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+                 .set_name(name_prefix + "pool"))
+            .add(SpatialConvolution(input_size, config[3][0], 1, 1, 1, 1,
+                                    init_method=init_mod.Xavier)
+                 .set_name(name_prefix + "pool_proj"))
+            .add(ReLU().set_name(name_prefix + "relu_pool_proj")))
+    concat.add(pool)
+    return concat
+
+
+def _v1_stem():
+    """conv1..pool2 shared stem (reference Inception_v1.scala:97-115)."""
+    return (Sequential()
+            .add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, 1,
+                                    propagate_back=False,
+                                    init_method=init_mod.Xavier)
+                 .set_name("conv1/7x7_s2"))
+            .add(ReLU().set_name("conv1/relu_7x7"))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+            .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+            .add(SpatialConvolution(64, 64, 1, 1, 1, 1,
+                                    init_method=init_mod.Xavier)
+                 .set_name("conv2/3x3_reduce"))
+            .add(ReLU().set_name("conv2/relu_3x3_reduce"))
+            .add(SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1,
+                                    init_method=init_mod.Xavier)
+                 .set_name("conv2/3x3"))
+            .add(ReLU().set_name("conv2/relu_3x3"))
+            .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
+
+
+def Inception_v1_NoAuxClassifier(class_num: int) -> Sequential:
+    """(reference Inception_v1.scala:60-94)"""
+    model = _v1_stem()
+    model.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
+                                 "inception_3a/"))
+    model.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
+                                 "inception_3b/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+    model.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
+                                 "inception_4a/"))
+    model.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
+                                 "inception_4b/"))
+    model.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
+                                 "inception_4c/"))
+    model.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
+                                 "inception_4d/"))
+    model.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
+                                 "inception_4e/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+    model.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
+                                 "inception_5a/"))
+    model.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
+                                 "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    model.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+    model.add(View(1024))
+    model.add(Linear(1024, class_num, init_method=init_mod.Xavier)
+              .set_name("loss3/classifier"))
+    model.add(LogSoftMax().set_name("loss3/loss3"))
+    return model
+
+
+def Inception_v1(class_num: int) -> Sequential:
+    """Full training graph with two auxiliary heads whose outputs concat
+    with the main head on the feature axis (reference Inception_v1.scala:96-176);
+    output shape (N, 3*classNum), head order [main, aux2, aux1]."""
+    feature1 = _v1_stem()
+    feature1.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
+                                    "inception_3a/"))
+    feature1.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
+                                    "inception_3b/"))
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+    feature1.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
+                                    "inception_4a/"))
+
+    output1 = (Sequential()
+               .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
+                    .set_name("loss1/ave_pool"))
+               .add(SpatialConvolution(512, 128, 1, 1, 1, 1,
+                                       init_method=init_mod.Xavier)
+                    .set_name("loss1/conv"))
+               .add(ReLU().set_name("loss1/relu_conv"))
+               .add(View(128 * 4 * 4))
+               .add(Linear(128 * 4 * 4, 1024, init_method=init_mod.Xavier)
+                    .set_name("loss1/fc"))
+               .add(ReLU().set_name("loss1/relu_fc"))
+               .add(Dropout(0.7).set_name("loss1/drop_fc"))
+               .add(Linear(1024, class_num, init_method=init_mod.Xavier)
+                    .set_name("loss1/classifier"))
+               .add(LogSoftMax().set_name("loss1/loss")))
+
+    feature2 = Sequential()
+    feature2.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
+                                    "inception_4b/"))
+    feature2.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
+                                    "inception_4c/"))
+    feature2.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
+                                    "inception_4d/"))
+
+    output2 = (Sequential()
+               .add(SpatialAveragePooling(5, 5, 3, 3).set_name("loss2/ave_pool"))
+               .add(SpatialConvolution(528, 128, 1, 1, 1, 1,
+                                       init_method=init_mod.Xavier)
+                    .set_name("loss2/conv"))
+               .add(ReLU().set_name("loss2/relu_conv"))
+               .add(View(128 * 4 * 4))
+               .add(Linear(128 * 4 * 4, 1024, init_method=init_mod.Xavier)
+                    .set_name("loss2/fc"))
+               .add(ReLU().set_name("loss2/relu_fc"))
+               .add(Dropout(0.7).set_name("loss2/drop_fc"))
+               .add(Linear(1024, class_num, init_method=init_mod.Xavier)
+                    .set_name("loss2/classifier"))
+               .add(LogSoftMax().set_name("loss2/loss")))
+
+    output3 = Sequential()
+    output3.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
+                                   "inception_4e/"))
+    output3.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+    output3.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
+                                   "inception_5a/"))
+    output3.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
+                                   "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    output3.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+    output3.add(View(1024))
+    output3.add(Linear(1024, class_num, init_method=init_mod.Xavier)
+                .set_name("loss3/classifier"))
+    output3.add(LogSoftMax().set_name("loss3/loss3"))
+
+    split2 = Concat(1).set_name("split2")
+    split2.add(output3)
+    split2.add(output2)
+
+    main_branch = Sequential().add(feature2).add(split2)
+
+    split1 = Concat(1).set_name("split1")
+    split1.add(main_branch)
+    split1.add(output1)
+
+    return Sequential().add(feature1).add(split1)
+
+
+def Inception_Layer_v2(input_size, config, name_prefix=""):
+    """BN-everywhere v2 block (reference Inception_v2.scala:25-103).
+
+    ``config`` = ((n1x1,), (n3x3r, n3x3), (nd3x3r, nd3x3), (pool, nproj))
+    where pool is "avg"/"max"; n1x1 == 0 marks a stride-2 downsample block
+    (no 1x1 branch, no pool projection).
+    """
+    concat = Concat(1).set_name(name_prefix + "output")
+    downsample = config[0][0] == 0
+    if not downsample:
+        conv1 = (Sequential()
+                 .add(SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1)
+                      .set_name(name_prefix + "1x1"))
+                 .add(SpatialBatchNormalization(config[0][0], 1e-3)
+                      .set_name(name_prefix + "1x1/bn"))
+                 .add(ReLU().set_name(name_prefix + "1x1/bn/sc/relu")))
+        concat.add(conv1)
+
+    stride = 2 if downsample else 1
+    conv3 = (Sequential()
+             .add(SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1)
+                  .set_name(name_prefix + "3x3_reduce"))
+             .add(SpatialBatchNormalization(config[1][0], 1e-3)
+                  .set_name(name_prefix + "3x3_reduce/bn"))
+             .add(ReLU().set_name(name_prefix + "3x3_reduce/bn/sc/relu"))
+             .add(SpatialConvolution(config[1][0], config[1][1], 3, 3,
+                                     stride, stride, 1, 1)
+                  .set_name(name_prefix + "3x3"))
+             .add(SpatialBatchNormalization(config[1][1], 1e-3)
+                  .set_name(name_prefix + "3x3/bn"))
+             .add(ReLU().set_name(name_prefix + "3x3/bn/sc/relu")))
+    concat.add(conv3)
+
+    conv3xx = (Sequential()
+               .add(SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1)
+                    .set_name(name_prefix + "double3x3_reduce"))
+               .add(SpatialBatchNormalization(config[2][0], 1e-3)
+                    .set_name(name_prefix + "double3x3_reduce/bn"))
+               .add(ReLU().set_name(name_prefix + "double3x3_reduce/bn/sc/relu"))
+               .add(SpatialConvolution(config[2][0], config[2][1], 3, 3,
+                                       1, 1, 1, 1)
+                    .set_name(name_prefix + "double3x3a"))
+               .add(SpatialBatchNormalization(config[2][1], 1e-3)
+                    .set_name(name_prefix + "double3x3a/bn"))
+               .add(ReLU().set_name(name_prefix + "double3x3a/bn/sc/relu"))
+               .add(SpatialConvolution(config[2][1], config[2][1], 3, 3,
+                                       stride, stride, 1, 1)
+                    .set_name(name_prefix + "double3x3b"))
+               .add(SpatialBatchNormalization(config[2][1], 1e-3)
+                    .set_name(name_prefix + "double3x3b/bn"))
+               .add(ReLU().set_name(name_prefix + "double3x3b/bn/sc/relu")))
+    concat.add(conv3xx)
+
+    pool = Sequential()
+    pool_kind = config[3][0]
+    if pool_kind == "max":
+        if downsample:
+            pool.add(SpatialMaxPooling(3, 3, 2, 2).ceil()
+                     .set_name(name_prefix + "pool"))
+        else:
+            pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+                     .set_name(name_prefix + "pool"))
+    elif pool_kind == "avg":
+        pool.add(SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil()
+                 .set_name(name_prefix + "pool"))
+    else:
+        raise ValueError(f"unknown pool kind {pool_kind}")
+    if config[3][1] != 0:
+        pool.add(SpatialConvolution(input_size, config[3][1], 1, 1, 1, 1)
+                 .set_name(name_prefix + "pool_proj"))
+        pool.add(SpatialBatchNormalization(config[3][1], 1e-3)
+                 .set_name(name_prefix + "pool_proj/bn"))
+        pool.add(ReLU().set_name(name_prefix + "pool_proj/bn/sc/relu"))
+    concat.add(pool)
+    return concat
+
+
+def _v2_stem():
+    """conv1..pool2 with BN (reference Inception_v2.scala:107-119)."""
+    return (Sequential()
+            .add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, 1,
+                                    propagate_back=False)
+                 .set_name("conv1/7x7_s2"))
+            .add(SpatialBatchNormalization(64, 1e-3).set_name("conv1/7x7_s2/bn"))
+            .add(ReLU().set_name("conv1/7x7_s2/bn/sc/relu"))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+            .add(SpatialConvolution(64, 64, 1, 1).set_name("conv2/3x3_reduce"))
+            .add(SpatialBatchNormalization(64, 1e-3)
+                 .set_name("conv2/3x3_reduce/bn"))
+            .add(ReLU().set_name("conv2/3x3_reduce/bn/sc/relu"))
+            .add(SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1)
+                 .set_name("conv2/3x3"))
+            .add(SpatialBatchNormalization(192, 1e-3).set_name("conv2/3x3/bn"))
+            .add(ReLU().set_name("conv2/3x3/bn/sc/relu"))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
+
+
+def Inception_v2_NoAuxClassifier(class_num: int) -> Sequential:
+    """(reference Inception_v2.scala:105-148)"""
+    model = _v2_stem()
+    model.add(Inception_Layer_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)),
+                                 "inception_3a/"))
+    model.add(Inception_Layer_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)),
+                                 "inception_3b/"))
+    model.add(Inception_Layer_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)),
+                                 "inception_3c/"))
+    model.add(Inception_Layer_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)),
+                                 "inception_4a/"))
+    model.add(Inception_Layer_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)),
+                                 "inception_4b/"))
+    model.add(Inception_Layer_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)),
+                                 "inception_4c/"))
+    model.add(Inception_Layer_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)),
+                                 "inception_4d/"))
+    model.add(Inception_Layer_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)),
+                                 "inception_4e/"))
+    model.add(Inception_Layer_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)),
+                                 "inception_5a/"))
+    model.add(Inception_Layer_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)),
+                                 "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).ceil().set_name("pool5/7x7_s1"))
+    model.add(View(1024))
+    model.add(Linear(1024, class_num).set_name("loss3/classifier"))
+    model.add(LogSoftMax().set_name("loss3/loss"))
+    return model
+
+
+def Inception_v2(class_num: int) -> Sequential:
+    """Full v2 training graph with two aux heads (reference
+    Inception_v2.scala:151-236); output (N, 3*classNum), heads
+    [main, aux2, aux1]."""
+    features1 = _v2_stem()
+    features1.add(Inception_Layer_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)),
+                                     "inception_3a/"))
+    features1.add(Inception_Layer_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)),
+                                     "inception_3b/"))
+    features1.add(Inception_Layer_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)),
+                                     "inception_3c/"))
+
+    output1 = (Sequential()
+               .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
+                    .set_name("pool3/5x5_s3"))
+               .add(SpatialConvolution(576, 128, 1, 1, 1, 1)
+                    .set_name("loss1/conv"))
+               .add(SpatialBatchNormalization(128, 1e-3)
+                    .set_name("loss1/conv/bn"))
+               .add(ReLU().set_name("loss1/conv/bn/sc/relu"))
+               .add(View(128 * 4 * 4))
+               .add(Linear(128 * 4 * 4, 1024).set_name("loss1/fc"))
+               .add(ReLU().set_name("loss1/fc/bn/sc/relu"))
+               .add(Linear(1024, class_num).set_name("loss1/classifier"))
+               .add(LogSoftMax().set_name("loss1/loss")))
+
+    features2 = Sequential()
+    features2.add(Inception_Layer_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)),
+                                     "inception_4a/"))
+    features2.add(Inception_Layer_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)),
+                                     "inception_4b/"))
+    features2.add(Inception_Layer_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)),
+                                     "inception_4c/"))
+    features2.add(Inception_Layer_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)),
+                                     "inception_4d/"))
+    features2.add(Inception_Layer_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)),
+                                     "inception_4e/"))
+
+    output2 = (Sequential()
+               .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
+                    .set_name("pool4/5x5_s3"))
+               .add(SpatialConvolution(1024, 128, 1, 1, 1, 1)
+                    .set_name("loss2/conv"))
+               .add(SpatialBatchNormalization(128, 1e-3)
+                    .set_name("loss2/conv/bn"))
+               .add(ReLU().set_name("loss2/conv/bn/sc/relu"))
+               .add(View(128 * 2 * 2))
+               .add(Linear(128 * 2 * 2, 1024).set_name("loss2/fc"))
+               .add(ReLU().set_name("loss2/fc/bn/sc/relu"))
+               .add(Linear(1024, class_num).set_name("loss2/classifier"))
+               .add(LogSoftMax().set_name("loss2/loss")))
+
+    output3 = Sequential()
+    output3.add(Inception_Layer_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)),
+                                   "inception_5a/"))
+    output3.add(Inception_Layer_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)),
+                                   "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1).ceil().set_name("pool5/7x7_s1"))
+    output3.add(View(1024))
+    output3.add(Linear(1024, class_num).set_name("loss3/classifier"))
+    output3.add(LogSoftMax().set_name("loss3/loss"))
+
+    split2 = Concat(1).set_name("split2")
+    split2.add(output3)
+    split2.add(output2)
+
+    main_branch = Sequential().add(features2).add(split2)
+
+    split1 = Concat(1).set_name("split1")
+    split1.add(main_branch)
+    split1.add(output1)
+
+    return Sequential().add(features1).add(split1)

@@ -1,0 +1,25 @@
+"""LeNet-5 (reference models/lenet/LeNet5.scala:23-39)."""
+from __future__ import annotations
+
+from bigdl_tpu.nn import (Linear, LogSoftMax, Reshape, Sequential,
+                          SpatialConvolution, SpatialMaxPooling, Tanh)
+
+__all__ = ["LeNet5"]
+
+
+def LeNet5(class_num: int) -> Sequential:
+    """Classic LeNet-5 over 28x28 grey images, exact layer sequence of the
+    reference (models/lenet/LeNet5.scala:24-38)."""
+    return (Sequential()
+            .add(Reshape((1, 28, 28)))
+            .add(SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+            .add(Tanh())
+            .add(SpatialMaxPooling(2, 2, 2, 2))
+            .add(Tanh())
+            .add(SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+            .add(SpatialMaxPooling(2, 2, 2, 2))
+            .add(Reshape((12 * 4 * 4,)))
+            .add(Linear(12 * 4 * 4, 100).set_name("fc1"))
+            .add(Tanh())
+            .add(Linear(100, class_num).set_name("fc2"))
+            .add(LogSoftMax()))

@@ -1,0 +1,193 @@
+"""ResNet for CIFAR-10 and ImageNet (reference models/resnet/ResNet.scala).
+
+Reference parity: ``basicBlock``/``bottleneck`` residual builders
+(ResNet.scala:161-199), shortcut types A/B/C (:142-159), depth configs
+(:211-263), He ``modelInit`` (:102-130: conv ~ N(0, sqrt(2/(k*k*nOut))),
+BN gamma=1 beta=0, linear bias=0).
+
+TPU-first: the reference's ``optnet``/``shareGradInput`` buffer-sharing
+(ResNet.scala:33-100) has no equivalent — XLA's buffer assignment already
+reuses HBM across non-overlapping live ranges.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import (CAddTable, Concat, ConcatTable, Identity, Linear,
+                          LogSoftMax, MulConstant, ReLU, Sequential,
+                          SpatialAveragePooling, SpatialBatchNormalization,
+                          SpatialConvolution, SpatialMaxPooling, View)
+from bigdl_tpu.nn.module import Container, Module
+from bigdl_tpu.tensor import default_dtype
+
+__all__ = ["ResNet", "ShortcutType", "DatasetType", "model_init"]
+
+
+class ShortcutType:
+    A = "A"  # zero-padded identity (CIFAR style)
+    B = "B"  # 1x1 conv when shape changes (default)
+    C = "C"  # 1x1 conv always
+
+
+class DatasetType:
+    CIFAR10 = "cifar10"
+    ImageNet = "imagenet"
+
+
+def _shortcut(n_input_plane, n_output_plane, stride, shortcut_type):
+    """(reference ResNet.scala:142-159)"""
+    use_conv = shortcut_type == ShortcutType.C or (
+        shortcut_type == ShortcutType.B and n_input_plane != n_output_plane)
+    if use_conv:
+        return (Sequential()
+                .add(SpatialConvolution(n_input_plane, n_output_plane, 1, 1,
+                                        stride, stride))
+                .add(SpatialBatchNormalization(n_output_plane)))
+    if n_input_plane != n_output_plane:
+        # type A: stride then zero-pad channels by concat with a zeroed copy
+        return (Sequential()
+                .add(SpatialAveragePooling(1, 1, stride, stride))
+                .add(Concat(1)
+                     .add(Identity())
+                     .add(MulConstant(0.0))))
+    return Identity()
+
+
+def _residual(body, n_input_plane, n, stride, shortcut_type):
+    return (Sequential()
+            .add(ConcatTable()
+                 .add(body)
+                 .add(_shortcut(n_input_plane, n, stride, shortcut_type)))
+            .add(CAddTable())
+            .add(ReLU()))
+
+
+def ResNet(class_num: int, opt: dict | None = None) -> Sequential:
+    """Build ResNet (reference ResNet.scala:133-265).
+
+    ``opt`` keys: depth (default 18), shortcutType (default B), dataset
+    (default CIFAR10), optnet (accepted, ignored — XLA shares buffers).
+    """
+    opt = dict(opt or {})
+    depth = opt.get("depth", 18)
+    shortcut_type = opt.get("shortcutType", ShortcutType.B)
+    dataset = opt.get("dataset", DatasetType.CIFAR10)
+
+    i_channels = [0]
+
+    def basic_block(n, stride):
+        """(reference ResNet.scala:161-177)"""
+        n_input_plane = i_channels[0]
+        i_channels[0] = n
+        s = (Sequential()
+             .add(SpatialConvolution(n_input_plane, n, 3, 3, stride, stride,
+                                     1, 1))
+             .add(SpatialBatchNormalization(n))
+             .add(ReLU())
+             .add(SpatialConvolution(n, n, 3, 3, 1, 1, 1, 1))
+             .add(SpatialBatchNormalization(n)))
+        return _residual(s, n_input_plane, n, stride, shortcut_type)
+
+    def bottleneck(n, stride):
+        """(reference ResNet.scala:179-199)"""
+        n_input_plane = i_channels[0]
+        i_channels[0] = n * 4
+        s = (Sequential()
+             .add(SpatialConvolution(n_input_plane, n, 1, 1, 1, 1, 0, 0))
+             .add(SpatialBatchNormalization(n))
+             .add(ReLU())
+             .add(SpatialConvolution(n, n, 3, 3, stride, stride, 1, 1))
+             .add(SpatialBatchNormalization(n))
+             .add(ReLU())
+             .add(SpatialConvolution(n, n * 4, 1, 1, 1, 1, 0, 0))
+             .add(SpatialBatchNormalization(n * 4)))
+        return _residual(s, n_input_plane, n * 4, stride, shortcut_type)
+
+    def layer(block, features, count, stride=1):
+        s = Sequential()
+        for i in range(count):
+            s.add(block(features, stride if i == 0 else 1))
+        return s
+
+    model = Sequential()
+    if dataset == DatasetType.ImageNet:
+        cfg = {18: ((2, 2, 2, 2), 512, basic_block),
+               34: ((3, 4, 6, 3), 512, basic_block),
+               50: ((3, 4, 6, 3), 2048, bottleneck),
+               101: ((3, 4, 23, 3), 2048, bottleneck),
+               152: ((3, 8, 36, 3), 2048, bottleneck),
+               200: ((3, 24, 36, 3), 2048, bottleneck)}
+        assert depth in cfg, f"Invalid depth {depth}"
+        loop_config, n_features, block = cfg[depth]
+        i_channels[0] = 64
+        (model.add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3))
+              .add(SpatialBatchNormalization(64))
+              .add(ReLU())
+              .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+              .add(layer(block, 64, loop_config[0]))
+              .add(layer(block, 128, loop_config[1], 2))
+              .add(layer(block, 256, loop_config[2], 2))
+              .add(layer(block, 512, loop_config[3], 2))
+              .add(SpatialAveragePooling(7, 7, 1, 1))
+              .add(View(n_features))
+              .add(Linear(n_features, class_num)))
+    elif dataset == DatasetType.CIFAR10:
+        assert (depth - 2) % 6 == 0, \
+            "depth should be one of 20, 32, 44, 56, 110, 1202"
+        n = (depth - 2) // 6
+        i_channels[0] = 16
+        (model.add(SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1))
+              .add(SpatialBatchNormalization(16))
+              .add(ReLU())
+              .add(layer(basic_block, 16, n))
+              .add(layer(basic_block, 32, n, 2))
+              .add(layer(basic_block, 64, n, 2))
+              .add(SpatialAveragePooling(8, 8, 1, 1))
+              .add(View(64))
+              .add(Linear(64, 10)))
+    else:
+        raise ValueError(f"Invalid dataset {dataset}")
+    return model
+
+
+def model_init(model: Module, rng=None):
+    """He init sweep (reference ResNet.modelInit, ResNet.scala:102-130):
+    conv weights ~ N(0, sqrt(2/(kW*kW*nOutputPlane))), bias 0; BN gamma 1,
+    beta 0; Linear bias 0. Mutates the materialized params in place."""
+    model.materialize()
+    rng = rng if rng is not None else jax.random.PRNGKey(42)
+    counter = [0]
+
+    def sweep(m: Module):
+        if isinstance(m, Container):
+            for child in m.modules:
+                sweep(child)
+            return
+        if isinstance(m, SpatialConvolution) and m.params:
+            counter[0] += 1
+            k = jax.random.fold_in(rng, counter[0])
+            n = m.kw * m.kw * m.n_output_plane
+            std = np.sqrt(2.0 / n)
+            m.params["weight"] = std * jax.random.normal(
+                k, m.params["weight"].shape, default_dtype())
+            if "bias" in m.params:
+                m.params["bias"] = jnp.zeros_like(m.params["bias"])
+        elif isinstance(m, (SpatialBatchNormalization,)) and m.params:
+            if "weight" in m.params:
+                m.params["weight"] = jnp.ones_like(m.params["weight"])
+            if "bias" in m.params:
+                m.params["bias"] = jnp.zeros_like(m.params["bias"])
+        elif isinstance(m, Linear) and m.params and "bias" in m.params:
+            m.params["bias"] = jnp.zeros_like(m.params["bias"])
+
+    sweep(model)
+    # re-collect child params into the container tree
+    def collect(m: Module):
+        if isinstance(m, Container):
+            m.params = {str(i): collect(c) for i, c in enumerate(m.modules)}
+        return m.params
+    collect(model)
+    model.grad_params = jax.tree.map(jnp.zeros_like, model.params)
+    return model

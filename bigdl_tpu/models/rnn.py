@@ -1,0 +1,32 @@
+"""Character/word-level simple RNN LM (reference models/rnn/SimpleRNN.scala).
+
+The reference model is ``Recurrent(RnnCell) -> Select(1,1) -> Linear`` and is
+trained with batchSize=1 padded pipelines (rnn/Train.scala:57-68): Select
+drops the singleton batch dim so Linear maps each timestep's hidden state to
+vocab logits. ``SimpleRNN`` mirrors that exactly (0-based ``Select(0, 0)``);
+``BatchedSimpleRNN`` is the TPU-friendly variant that keeps the batch dim via
+``TimeDistributed`` so large batches feed the MXU.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.nn import (Linear, LogSoftMax, Recurrent, RnnCell, Select,
+                          Sequential, TimeDistributed)
+
+__all__ = ["SimpleRNN", "BatchedSimpleRNN"]
+
+
+def SimpleRNN(input_size: int, hidden_size: int, output_size: int) -> Sequential:
+    """(reference SimpleRNN.scala:22-35; batch-size-1 semantics)"""
+    return (Sequential()
+            .add(Recurrent(RnnCell(input_size, hidden_size, "tanh")))
+            .add(Select(0, 0))
+            .add(Linear(hidden_size, output_size)))
+
+
+def BatchedSimpleRNN(input_size: int, hidden_size: int,
+                     output_size: int) -> Sequential:
+    """Batch-preserving variant: (N, T, I) -> (N, T, output) log-probs."""
+    return (Sequential()
+            .add(Recurrent(RnnCell(input_size, hidden_size, "tanh")))
+            .add(TimeDistributed(Linear(hidden_size, output_size)))
+            .add(LogSoftMax()))

@@ -1,0 +1,91 @@
+"""VGG nets (reference models/vgg/VggForCifar10.scala).
+
+``VggForCifar10`` — conv-BN-ReLU blocks with dropout (reference :22-68);
+``Vgg_16``/``Vgg_19`` — ImageNet variants used by the perf harness
+(reference :70-187, models/utils/DistriOptimizerPerf.scala:33-70).
+"""
+from __future__ import annotations
+
+from bigdl_tpu.nn import (BatchNormalization, Dropout, Linear, LogSoftMax,
+                          ReLU, Sequential, SpatialBatchNormalization,
+                          SpatialConvolution, SpatialMaxPooling, Threshold,
+                          View)
+
+__all__ = ["VggForCifar10", "Vgg_16", "Vgg_19"]
+
+
+def VggForCifar10(class_num: int) -> Sequential:
+    model = Sequential()
+
+    def conv_bn_relu(n_in, n_out):
+        model.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(n_out, 1e-3))
+        model.add(ReLU())
+        return model
+
+    conv_bn_relu(3, 64).add(Dropout(0.3))
+    conv_bn_relu(64, 64)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(64, 128).add(Dropout(0.4))
+    conv_bn_relu(128, 128)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(128, 256).add(Dropout(0.4))
+    conv_bn_relu(256, 256).add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(256, 512).add(Dropout(0.4))
+    conv_bn_relu(512, 512).add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(512, 512).add(Dropout(0.4))
+    conv_bn_relu(512, 512).add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    model.add(View(512))
+
+    classifier = (Sequential()
+                  .add(Dropout(0.5))
+                  .add(Linear(512, 512))
+                  .add(BatchNormalization(512))
+                  .add(ReLU())
+                  .add(Dropout(0.5))
+                  .add(Linear(512, class_num))
+                  .add(LogSoftMax()))
+    model.add(classifier)
+    return model
+
+
+def _vgg_imagenet(conv_counts, class_num: int) -> Sequential:
+    """Shared VGG-16/19 body; conv_counts = convs per block."""
+    model = Sequential()
+    n_in = 3
+    for n_out, count in zip((64, 128, 256, 512, 512), conv_counts):
+        for _ in range(count):
+            model.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+            model.add(ReLU())
+            n_in = n_out
+        model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(View(512 * 7 * 7))
+    model.add(Linear(512 * 7 * 7, 4096))
+    model.add(Threshold(0, 1e-6))
+    model.add(Dropout(0.5))
+    model.add(Linear(4096, 4096))
+    model.add(Threshold(0, 1e-6))
+    model.add(Dropout(0.5))
+    model.add(Linear(4096, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int) -> Sequential:
+    """(reference VggForCifar10.scala:70-127)"""
+    return _vgg_imagenet((2, 2, 3, 3, 3), class_num)
+
+
+def Vgg_19(class_num: int) -> Sequential:
+    """(reference VggForCifar10.scala:130-187)"""
+    return _vgg_imagenet((2, 2, 4, 4, 4), class_num)

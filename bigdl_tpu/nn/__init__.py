@@ -9,7 +9,7 @@ from bigdl_tpu.nn.activations import (
     ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, Tanh, TanhShrink, Sigmoid,
     LogSigmoid, SoftMax, SoftMin, LogSoftMax, SoftPlus, SoftSign, HardTanh,
     HardShrink, SoftShrink, Threshold, Clamp, Power, Sqrt, Square, Abs, Log,
-    Exp, GradientReversal, Scale)
+    Exp, GradientReversal, Scale, MulConstant, AddConstant)
 from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialShareConvolution,
                                SpatialFullConvolution,
                                SpatialDilatedConvolution,

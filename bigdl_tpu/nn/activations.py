@@ -19,7 +19,8 @@ __all__ = ["ReLU", "ReLU6", "PReLU", "RReLU", "LeakyReLU", "ELU", "Tanh",
            "TanhShrink", "Sigmoid", "LogSigmoid", "SoftMax", "SoftMin",
            "LogSoftMax", "SoftPlus", "SoftSign", "HardTanh", "HardShrink",
            "SoftShrink", "Threshold", "Clamp", "Power", "Sqrt", "Square",
-           "Abs", "Log", "Exp", "GradientReversal", "Scale"]
+           "Abs", "Log", "Exp", "GradientReversal", "Scale",
+           "MulConstant", "AddConstant"]
 
 
 class _Elementwise(Module):
@@ -279,3 +280,26 @@ class Scale(Module):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         return x * params["weight"] + params["bias"], state
+
+
+class MulConstant(Module):
+    """Multiply by a fixed scalar (reference nn/MulConstant.scala; used by
+    ResNet shortcut type A zero-padding branch, models/resnet/ResNet.scala:142-148)."""
+
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant = constant_scalar
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * self.constant, state
+
+
+class AddConstant(Module):
+    """Add a fixed scalar (reference nn/AddConstant.scala)."""
+
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant = constant_scalar
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + self.constant, state

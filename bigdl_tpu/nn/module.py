@@ -196,6 +196,11 @@ class Module:
         self._name = name
         return self
 
+    def set_init_method(self, method: str):
+        """Chainable init-method override (reference ``setInitMethod``)."""
+        self.init_method = method
+        return self
+
     def get_name(self) -> str:
         return self._name or f"{type(self).__name__}@{id(self):x}"
 

@@ -67,13 +67,26 @@ class View(Module):
         if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
             sizes = tuple(sizes[0])
         self.sizes = tuple(sizes)
+        self.num_input_dims = None
+
+    def set_num_input_dims(self, n: int):
+        """(reference View.setNumInputDims) — inputs with more than ``n``
+        dims carry a leading batch axis that is preserved."""
+        self.num_input_dims = n
+        return self
 
     def apply(self, params, state, x, *, training=False, rng=None):
         import numpy as np
         n = int(np.prod([s for s in self.sizes if s > 0]))
-        if x.size == n and -1 not in self.sizes:
-            return x.reshape(self.sizes), state
-        return x.reshape((x.shape[0],) + self.sizes), state
+        if self.num_input_dims is not None:
+            batched = x.ndim > self.num_input_dims
+        else:
+            # treat dim 0 as batch whenever the target accounts for the rest
+            batched = x.ndim > len(self.sizes) and \
+                x.size == x.shape[0] * n and -1 not in self.sizes
+        if batched or (x.size != n and -1 not in self.sizes) or -1 in self.sizes:
+            return x.reshape((x.shape[0],) + self.sizes), state
+        return x.reshape(self.sizes), state
 
 
 class Transpose(Module):

@@ -1,0 +1,153 @@
+"""Model-zoo tests (mirrors reference models/ specs — AlexNetSpec,
+InceptionSpec, ResNetSpec, ModelGraientCheckSpec; SURVEY §4.5).
+
+Shapes use small spatial inputs where the architecture allows; the ImageNet
+models are exercised at full 224x224 with batch 1 (forward only) and are
+marked slow-ish but still CPU-feasible.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import models
+from bigdl_tpu.nn import ClassNLLCriterion, MSECriterion
+
+
+def fwd(model, x, training=False):
+    model.materialize(jax.random.PRNGKey(0))
+    y, _ = model.apply(model.params, model.state, x, training=training,
+                       rng=jax.random.PRNGKey(1))
+    return y
+
+
+class TestLeNet5:
+    def test_forward_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 1, 28, 28))
+        assert fwd(models.LeNet5(10), x).shape == (4, 10)
+
+    def test_log_softmax_output(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 1, 28, 28))
+        y = fwd(models.LeNet5(10), x)
+        np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0,
+                                   rtol=1e-4)
+
+    def test_trains_on_tiny_batch(self):
+        """A few SGD steps must reduce NLL loss — gradient sanity for the
+        whole stack (reference ModelGraientCheckSpec analogue)."""
+        model = models.LeNet5(10)
+        model.materialize(jax.random.PRNGKey(0))
+        crit = ClassNLLCriterion()
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 1, 28, 28))
+        t = jnp.arange(8) % 10
+
+        def loss_fn(params):
+            y, _ = model.apply(params, model.state, x, training=False)
+            return crit.apply(y, t)
+
+        params = model.params
+        l0 = loss_fn(params)
+        g = jax.grad(loss_fn)(params)
+        for _ in range(5):
+            g = jax.grad(loss_fn)(params)
+            params = jax.tree.map(lambda p, gi: p - 0.5 * gi, params, g)
+        assert float(loss_fn(params)) < float(l0)
+
+
+class TestAutoencoder:
+    def test_reconstruction_shape_and_range(self):
+        x = jax.random.uniform(jax.random.PRNGKey(0), (4, 1, 28, 28))
+        y = fwd(models.Autoencoder(32), x)
+        assert y.shape == (4, 784)
+        assert float(jnp.min(y)) >= 0.0 and float(jnp.max(y)) <= 1.0
+
+
+class TestInception:
+    def test_v1_no_aux_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        assert fwd(models.Inception_v1_NoAuxClassifier(100), x).shape == (1, 100)
+
+    def test_v1_aux_heads_concat(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        y = fwd(models.Inception_v1(50), x)
+        # three LogSoftMax heads concatenated on features
+        assert y.shape == (1, 150)
+        p = np.exp(np.asarray(y)).reshape(3, 50)
+        np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-3)
+
+    def test_layer_v1_channel_math(self):
+        blk = models.Inception_Layer_v1(
+            192, ((64,), (96, 128), (16, 32), (32,)))
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 192, 28, 28))
+        assert fwd(blk, x).shape == (2, 64 + 128 + 32 + 32, 28, 28)
+
+    def test_layer_v2_downsample(self):
+        blk = models.Inception_Layer_v2(
+            320, ((0,), (128, 160), (64, 96), ("max", 0)))
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 320, 28, 28))
+        assert fwd(blk, x).shape == (2, 160 + 96 + 320, 14, 14)
+
+    def test_v2_no_aux_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        assert fwd(models.Inception_v2_NoAuxClassifier(10), x).shape == (1, 10)
+
+
+class TestVgg:
+    def test_cifar_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        assert fwd(models.VggForCifar10(10), x).shape == (2, 10)
+
+    def test_vgg16_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        assert fwd(models.Vgg_16(10), x).shape == (1, 10)
+
+
+class TestResNet:
+    def test_cifar_depths(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        for depth in (20, 32):
+            assert fwd(models.ResNet(10, {"depth": depth}), x).shape == (2, 10)
+
+    def test_imagenet_bottleneck(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        m = models.ResNet(7, {"depth": 50, "dataset": models.DatasetType.ImageNet})
+        assert fwd(m, x).shape == (1, 7)
+
+    def test_shortcut_type_a_zero_pads(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        m = models.ResNet(10, {"depth": 20,
+                               "shortcutType": models.ShortcutType.A})
+        assert fwd(m, x).shape == (2, 10)
+
+    def test_model_init_statistics(self):
+        m = models.ResNet(10, {"depth": 20})
+        models.model_init(m)
+        # first conv: He std sqrt(2/(3*3*16))
+        w = np.asarray(m.params["0"]["weight"])
+        assert abs(w.std() - np.sqrt(2.0 / (3 * 3 * 16))) < 0.02
+        assert np.all(np.asarray(m.params["1"]["weight"]) == 1.0)
+
+
+class TestSimpleRNN:
+    def test_reference_semantics(self):
+        """batchSize=1: (1,T,I) -> (T,output) (reference SimpleRNN +
+        Select(1,1), models/rnn/SimpleRNN.scala:22-35)."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 5, 20))
+        assert fwd(models.SimpleRNN(20, 16, 20), x).shape == (5, 20)
+
+    def test_batched_variant(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 5, 20))
+        y = fwd(models.BatchedSimpleRNN(20, 16, 20), x)
+        assert y.shape == (4, 5, 20)
+        np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0,
+                                   rtol=1e-3)
+
+
+class TestAlexNet:
+    def test_owt_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        assert fwd(models.AlexNet_OWT(10), x).shape == (1, 10)
+
+    def test_caffe_layout_groups(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 227, 227))
+        assert fwd(models.AlexNet(10), x).shape == (1, 10)
