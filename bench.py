@@ -1,0 +1,93 @@
+"""Headline benchmark: Inception-v1 ImageNet training throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Mirrors the reference's synthetic-data perf harness
+(models/utils/DistriOptimizerPerf.scala:33-70 / LocalOptimizerPerf.scala —
+inception_v1, random input, records/second averaged over timed iterations).
+
+Baseline derivation (BASELINE.md): the reference publishes NO quantitative
+table; its README claims single-node Xeon training "comparable with
+mainstream GPU" (README.md:9). A mainstream 2016 GPU (K80-class) trains
+Inception-v1 at ~150 images/sec, so 150 img/s/device is the documented
+stand-in baseline; ``vs_baseline`` = value / 150.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 150.0
+BATCH = 128
+WARMUP = 3
+ITERS = 30
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.tensor import DTypePolicy, set_policy
+
+    # bf16 MXU compute, f32 params — the TPU-native equivalent of the
+    # reference's FP16-on-the-wire + f32 math split (SURVEY §5.8)
+    set_policy(DTypePolicy(param_dtype=jnp.float32,
+                           compute_dtype=jnp.bfloat16))
+
+    model = Inception_v1_NoAuxClassifier(1000)
+    model.materialize(jax.random.PRNGKey(0))
+    model.training()
+    criterion = nn.ClassNLLCriterion()
+    optim = SGD(learning_rate=0.0898, momentum=0.9)
+
+    params, mstate = model.params, model.state
+    opt_state = optim.init_state(params)
+
+    def train_step(params, mstate, opt_state, rng, data, labels):
+        def loss_fn(p):
+            y, new_state = model.apply(p, mstate, data, training=True,
+                                       rng=rng)
+            return criterion.apply(y, labels), new_state
+
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt_state = optim.update(grads, params, opt_state)
+        return new_params, new_mstate, new_opt_state, loss
+
+    jit_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    rng = jax.random.PRNGKey(0)
+    host = np.random.default_rng(0)
+    data = jnp.asarray(host.standard_normal((BATCH, 3, 224, 224), np.float32))
+    labels = jnp.asarray(host.integers(0, 1000, size=(BATCH,)))
+
+    for _ in range(WARMUP):
+        rng, k = jax.random.split(rng)
+        params, mstate, opt_state, loss = jit_step(params, mstate, opt_state,
+                                                   k, data, labels)
+    float(loss)  # block_until_ready is a no-op through the axon tunnel
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        rng, k = jax.random.split(rng)
+        params, mstate, opt_state, loss = jit_step(params, mstate, opt_state,
+                                                   k, data, labels)
+    float(loss)  # force a real device sync before stopping the clock
+    dt = time.perf_counter() - t0
+
+    value = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "inception_v1_train_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

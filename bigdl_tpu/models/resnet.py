@@ -71,9 +71,12 @@ def ResNet(class_num: int, opt: dict | None = None) -> Sequential:
     (default CIFAR10), optnet (accepted, ignored — XLA shares buffers).
     """
     opt = dict(opt or {})
-    depth = opt.get("depth", 18)
-    shortcut_type = opt.get("shortcutType", ShortcutType.B)
     dataset = opt.get("dataset", DatasetType.CIFAR10)
+    # reference default depth is 18, but 18 is invalid for its default
+    # CIFAR-10 path ((depth-2)%6 != 0) — default to the smallest valid
+    # depth per dataset instead of crashing
+    depth = opt.get("depth", 18 if dataset == DatasetType.ImageNet else 20)
+    shortcut_type = opt.get("shortcutType", ShortcutType.B)
 
     i_channels = [0]
 
@@ -146,7 +149,7 @@ def ResNet(class_num: int, opt: dict | None = None) -> Sequential:
               .add(layer(basic_block, 64, n, 2))
               .add(SpatialAveragePooling(8, 8, 1, 1))
               .add(View(64))
-              .add(Linear(64, 10)))
+              .add(Linear(64, class_num)))
     else:
         raise ValueError(f"Invalid dataset {dataset}")
     return model
@@ -183,11 +186,7 @@ def model_init(model: Module, rng=None):
             m.params["bias"] = jnp.zeros_like(m.params["bias"])
 
     sweep(model)
-    # re-collect child params into the container tree
-    def collect(m: Module):
-        if isinstance(m, Container):
-            m.params = {str(i): collect(c) for i, c in enumerate(m.modules)}
-        return m.params
-    collect(model)
+    # sweep assigns into the same per-module dicts the container tree
+    # references, so model.params is already updated
     model.grad_params = jax.tree.map(jnp.zeros_like, model.params)
     return model

@@ -197,7 +197,14 @@ class Module:
         return self
 
     def set_init_method(self, method: str):
-        """Chainable init-method override (reference ``setInitMethod``)."""
+        """Chainable init-method override (reference ``setInitMethod``).
+
+        Must be called before ``materialize`` — init_method is only read
+        when parameters are created."""
+        if self.params is not None:
+            raise RuntimeError(
+                "set_init_method after materialize has no effect; call it "
+                "before the first forward/materialize")
         self.init_method = method
         return self
 

@@ -33,8 +33,12 @@ class Reshape(Module):
     def apply(self, params, state, x, *, training=False, rng=None):
         import numpy as np
         n = int(np.prod(self.size))
+        # infer like the reference (Reshape.scala): batched when the
+        # element count is batch*n, even at batch 1 (x.size == n alone is
+        # ambiguous there — require the leading dim to account for it)
         batch = (self.batch_mode if self.batch_mode is not None
-                 else x.ndim > len(self.size) and x.size != n)
+                 else x.ndim > len(self.size)
+                 and x.size == x.shape[0] * n)
         if batch:
             return x.reshape((x.shape[0],) + self.size), state
         return x.reshape(self.size), state

@@ -39,7 +39,7 @@ class TestLeNet5:
         model.materialize(jax.random.PRNGKey(0))
         crit = ClassNLLCriterion()
         x = jax.random.normal(jax.random.PRNGKey(1), (8, 1, 28, 28))
-        t = jnp.arange(8) % 10
+        t = jnp.arange(8) % 10 + 1  # ClassNLL targets are 1-based
 
         def loss_fn(params):
             y, _ = model.apply(params, model.state, x, training=False)
