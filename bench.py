@@ -64,7 +64,7 @@ def main():
     rng = jax.random.PRNGKey(0)
     host = np.random.default_rng(0)
     data = jnp.asarray(host.standard_normal((BATCH, 3, 224, 224), np.float32))
-    labels = jnp.asarray(host.integers(0, 1000, size=(BATCH,)))
+    labels = jnp.asarray(host.integers(1, 1001, size=(BATCH,)))  # 1-based
 
     for _ in range(WARMUP):
         rng, k = jax.random.split(rng)

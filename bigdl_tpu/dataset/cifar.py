@@ -1,0 +1,41 @@
+"""CIFAR-10 binary reader (reference models/vgg/Utils.scala /
+models/resnet/Utils.scala — 3073-byte records: label + 32x32x3 RGB planes)
+plus the reference training statistics."""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from bigdl_tpu.dataset.image.types import LabeledBGRImage
+
+__all__ = ["load_bin", "load_folder", "TRAIN_MEAN", "TRAIN_STD"]
+
+# reference models/vgg/Utils.scala trainMean/trainStd ((R,G,B) of [0,255])
+TRAIN_MEAN = (125.33761, 122.96133, 113.8664)
+TRAIN_STD = (62.99322675508508, 62.08871334906125, 66.70490641235472)
+
+
+def load_bin(path: str):
+    """One data_batch_*.bin file -> list of LabeledBGRImage (pixels [0,255],
+    labels 1-based)."""
+    raw = np.frombuffer(Path(path).read_bytes(), np.uint8)
+    rec = raw.reshape(-1, 3073)
+    labels = rec[:, 0].astype(np.float32) + 1.0
+    # stored as RGB planes (3, 32, 32) -> HWC BGR
+    imgs = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    imgs = imgs[..., ::-1].astype(np.float32)
+    return [LabeledBGRImage(img, float(lab))
+            for img, lab in zip(imgs, labels)]
+
+
+def load_folder(folder: str, train: bool = True):
+    """data_batch_1..5.bin for train, test_batch.bin for eval (reference
+    Utils.loadTrain/loadTest)."""
+    folder = Path(folder)
+    files = ([folder / f"data_batch_{i}.bin" for i in range(1, 6)]
+             if train else [folder / "test_batch.bin"])
+    out = []
+    for f in files:
+        out.extend(load_bin(str(f)))
+    return out

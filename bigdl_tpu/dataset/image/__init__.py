@@ -1,0 +1,10 @@
+"""Image data pipeline (reference dataset/image/, SURVEY §2.5)."""
+
+from bigdl_tpu.dataset.image.types import (LabeledImage, LabeledBGRImage,
+                                           LabeledGreyImage)
+from bigdl_tpu.dataset.image.transforms import (
+    BytesToBGRImg, BytesToGreyImg, LocalImgReader, LocalImageFiles,
+    BGRImgCropper, GreyImgCropper, BGRImgRdmCropper, CropRandom, CropCenter,
+    BGRImgNormalizer, GreyImgNormalizer, BGRImgPixelNormalizer,
+    HFlip, ColorJitter, Lighting,
+    BGRImgToBatch, GreyImgToBatch, MTImgToBatch)

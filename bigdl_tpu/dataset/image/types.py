@@ -1,0 +1,49 @@
+"""Image carriers (reference dataset/image/Types.scala — LabeledBGRImage /
+LabeledGreyImage, 375 LoC of manual float-array plumbing).
+
+TPU-first: images are numpy ``(H, W, C)`` float32 arrays (C=3 BGR to match
+the reference's channel order, or C absent for grey); the to-batch
+transformers emit NCHW MiniBatches ready for ``jax.device_put``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LabeledImage", "LabeledBGRImage", "LabeledGreyImage"]
+
+
+class LabeledImage:
+    """content (H, W[, C]) float32 + float label."""
+
+    __slots__ = ("content", "label")
+
+    def __init__(self, content: np.ndarray, label: float = 0.0):
+        self.content = np.asarray(content, np.float32)
+        self.label = label
+
+    @property
+    def height(self) -> int:
+        return self.content.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.content.shape[1]
+
+    def set_label(self, label: float):
+        self.label = label
+        return self
+
+    def clone(self):
+        return type(self)(self.content.copy(), self.label)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.content.shape}, "
+                f"label={self.label})")
+
+
+class LabeledBGRImage(LabeledImage):
+    """(H, W, 3) in B,G,R channel order (reference Types.scala)."""
+
+
+class LabeledGreyImage(LabeledImage):
+    """(H, W) single channel."""

@@ -1,0 +1,55 @@
+"""MNIST idx-ubyte reader (reference models/lenet/Utils.scala:load — big-
+endian magic 2049/2051 label/image files) + the canonical normalization
+constants used by the reference LeNet pipeline."""
+from __future__ import annotations
+
+import gzip
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from bigdl_tpu.dataset.image.types import LabeledGreyImage
+
+__all__ = ["load_images", "load_labels", "load", "TRAIN_MEAN", "TRAIN_STD",
+           "TEST_MEAN", "TEST_STD"]
+
+# reference models/lenet/Utils.scala trainMean/trainStd (of [0,1] pixels)
+TRAIN_MEAN = 0.13066047740239506
+TRAIN_STD = 0.3081078
+TEST_MEAN = 0.13251460696903547
+TEST_STD = 0.31048024
+
+
+def _open(path):
+    p = Path(path)
+    if p.suffix == ".gz":
+        return gzip.open(p, "rb")
+    return open(p, "rb")
+
+
+def load_images(path: str) -> np.ndarray:
+    """(N, 28, 28) uint8 (reference Utils.load, magic 2051)."""
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad image magic {magic}"
+        buf = f.read(n * rows * cols)
+    return np.frombuffer(buf, np.uint8).reshape(n, rows, cols)
+
+
+def load_labels(path: str) -> np.ndarray:
+    """(N,) float32 1-based labels (reference loads label+1 for
+    ClassNLL)."""
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad label magic {magic}"
+        buf = f.read(n)
+    return np.frombuffer(buf, np.uint8).astype(np.float32) + 1.0
+
+
+def load(image_path: str, label_path: str):
+    """List of LabeledGreyImage with [0,1] pixel values."""
+    images = load_images(image_path).astype(np.float32) / 255.0
+    labels = load_labels(label_path)
+    return [LabeledGreyImage(img, float(lab))
+            for img, lab in zip(images, labels)]

@@ -1,0 +1,5 @@
+"""Text data pipeline (reference dataset/text/, SURVEY §2.5)."""
+
+from bigdl_tpu.dataset.text.transforms import (
+    Dictionary, SentenceToken, SentenceSplitter, SentenceTokenizer,
+    SentenceBiPadding, TextToLabeledSentence, LabeledSentenceToSample)

@@ -1,0 +1,2 @@
+"""autoencoder model family (reference models/autoencoder/)."""
+from bigdl_tpu.models.autoencoder.model import *  # noqa: F401,F403

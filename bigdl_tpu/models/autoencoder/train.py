@@ -1,0 +1,63 @@
+"""Autoencoder MNIST training main (reference models/autoencoder/Train.scala
+— MSE reconstruction, target = input image)."""
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.models.lenet.train import find
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train Autoencoder on MNIST")
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import mnist
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.dataset.transformer import Transformer
+    from bigdl_tpu.models import Autoencoder
+    from bigdl_tpu.optim import Adagrad, Optimizer, max_epoch
+    from bigdl_tpu.utils import file as bfile
+
+    class GreyImgToReconstructionBatch(Transformer):
+        """Batch with labels == flattened inputs (reference
+        autoencoder/Train.scala toAutoencoderBatch)."""
+
+        def __init__(self, batch_size):
+            self.batch_size = batch_size
+
+        def __call__(self, it):
+            feats = []
+            for img in it:
+                feats.append(img.content[None])
+                if len(feats) == self.batch_size:
+                    data = np.stack(feats)
+                    yield MiniBatch(data, data.reshape(len(feats), -1))
+                    feats = []
+
+    batch = args.batchSize or 150
+    train = LocalArrayDataSet(mnist.load(
+        find(args.folder, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"]),
+        find(args.folder, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])))
+    train_set = train >> GreyImgToReconstructionBatch(batch)
+
+    model = (bfile.load_module(args.model) if args.model
+             else Autoencoder(class_num=32))
+    optimizer = Optimizer(model, train_set, nn.MSECriterion(), mesh=mesh)
+    optimizer.set_optim_method(Adagrad(
+        learning_rate=args.learningRate or 0.01,
+        learning_rate_decay=0.0))
+    if args.checkpoint:
+        from bigdl_tpu.optim import every_epoch
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 10))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""inception model family (reference models/inception/)."""
+from bigdl_tpu.models.inception.model import *  # noqa: F401,F403

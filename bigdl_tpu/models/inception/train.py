@@ -1,0 +1,90 @@
+"""Inception-v1/v2 ImageNet training main (reference
+models/inception/Train.scala + Options.scala).
+
+Run: ``python -m bigdl_tpu.models.inception.train -f <imagenet_dir>`` where
+the folder holds class-per-subdirectory images (train/ and val/). The
+reference consumed Hadoop SequenceFiles of raw JPEGs; the TPU pipeline
+reads image files directly with threaded decode + prefetch (MTImgToBatch).
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+# ImageNet BGR pixel means used by the reference pipeline
+# (inception/ImageNet2012.scala normalizer)
+MEAN_RGB = (0.485, 0.456, 0.406)
+STD_RGB = (0.229, 0.224, 0.225)
+
+
+def build_pipeline(folder, batch, train, image_size=224, threads=8):
+    import os
+
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                         CropCenter, CropRandom, HFlip,
+                                         LocalImageFiles, LocalImgReader,
+                                         MTImgToBatch)
+
+    sub = os.path.join(folder, "train" if train else "val")
+    paths = LocalImageFiles.paths(sub if os.path.isdir(sub) else folder,
+                                  shuffle=train)
+    inner = LocalImgReader(scale_to=256) \
+        >> BGRImgCropper(image_size, image_size,
+                         CropRandom if train else CropCenter) \
+        >> HFlip(0.5 if train else 0.0) \
+        >> BGRImgNormalizer(MEAN_RGB, std_r=STD_RGB)
+    ds = LocalArrayDataSet(paths)
+    return ds >> MTImgToBatch(batch, inner, num_threads=threads)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train Inception on ImageNet")
+    parser.add_argument("--modelName", default="inception-v1",
+                        choices=["inception-v1", "inception-v2"])
+    parser.add_argument("--classNum", type=int, default=1000)
+    parser.add_argument("--maxIteration", type=int, default=62000)
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import (Inception_v1_NoAuxClassifier,
+                                  Inception_v2_NoAuxClassifier)
+    from bigdl_tpu.optim import (Optimizer, Poly, SGD, Top1Accuracy,
+                                 Top5Accuracy, max_iteration,
+                                 several_iteration)
+    from bigdl_tpu.utils import file as bfile
+
+    batch = args.batchSize or 256
+    train_set = build_pipeline(args.folder, batch, train=True)
+    val_set = build_pipeline(args.folder, batch, train=False)
+
+    if args.model:
+        model = bfile.load_module(args.model)
+    elif args.modelName == "inception-v2":
+        model = Inception_v2_NoAuxClassifier(args.classNum)
+    else:
+        model = Inception_v1_NoAuxClassifier(args.classNum)
+
+    optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
+    # reference recipe (inception/Train.scala:70-88): lr 0.0898,
+    # Poly(0.5, maxIteration)
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.0898,
+        weight_decay=0.0001, momentum=0.9,
+        learning_rate_schedule=Poly(0.5, args.maxIteration)))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(several_iteration(620), val_set,
+                             [Top1Accuracy(), Top5Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, several_iteration(620))
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_iteration(args.maxIteration))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""lenet model family (reference models/lenet/)."""
+from bigdl_tpu.models.lenet.model import *  # noqa: F401,F403

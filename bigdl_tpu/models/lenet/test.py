@@ -1,0 +1,33 @@
+"""LeNet-5 MNIST evaluation main (reference models/lenet/Test.scala:38-62)."""
+from __future__ import annotations
+
+from bigdl_tpu.models.lenet.train import find
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    args = base_test_parser("Test LeNet-5 on MNIST").parse_args(argv)
+    init_engine()
+
+    from bigdl_tpu.dataset import mnist
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import GreyImgNormalizer, GreyImgToBatch
+    from bigdl_tpu.optim import Top1Accuracy, Validator
+    from bigdl_tpu.utils import file as bfile
+
+    val = LocalArrayDataSet(mnist.load(
+        find(args.folder, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"]),
+        find(args.folder, ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])))
+    val_set = val >> GreyImgNormalizer(mnist.TEST_MEAN, mnist.TEST_STD) \
+        >> GreyImgToBatch(args.batchSize)
+
+    model = bfile.load_module(args.model)
+    results = Validator(model, val_set).test([Top1Accuracy()])
+    for result, method in results:
+        print(f"{method!r} is {result!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""LeNet-5 MNIST training main (reference models/lenet/Train.scala:40-101).
+
+Run: ``python -m bigdl_tpu.models.lenet.train -f <mnist_dir> -b 128``.
+Expects train-images-idx3-ubyte[.gz] / train-labels-idx1-ubyte[.gz] (and the
+t10k files for validation) under ``--folder``, like the reference.
+"""
+from __future__ import annotations
+
+import os
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def find(folder, names):
+    for n in names:
+        p = os.path.join(folder, n)
+        if os.path.exists(p):
+            return p
+        if os.path.exists(p + ".gz"):
+            return p + ".gz"
+    raise FileNotFoundError(f"none of {names} under {folder}")
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train LeNet-5 on MNIST")
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import mnist
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import GreyImgNormalizer, GreyImgToBatch
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, every_epoch,
+                                 max_epoch)
+    from bigdl_tpu.utils import file as bfile
+
+    batch = args.batchSize or 128
+    train = LocalArrayDataSet(mnist.load(
+        find(args.folder, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"]),
+        find(args.folder, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])))
+    val = LocalArrayDataSet(mnist.load(
+        find(args.folder, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"]),
+        find(args.folder, ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])))
+
+    train_set = train >> GreyImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD) \
+        >> GreyImgToBatch(batch, drop_remainder=True)
+    val_set = val >> GreyImgNormalizer(mnist.TEST_MEAN, mnist.TEST_STD) \
+        >> GreyImgToBatch(batch)
+
+    model = (bfile.load_module(args.model) if args.model
+             else LeNet5(class_num=10))
+    optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.05,
+        learning_rate_decay=0.0))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(every_epoch(), val_set, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 15))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

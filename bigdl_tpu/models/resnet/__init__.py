@@ -1,0 +1,2 @@
+"""resnet model family (reference models/resnet/)."""
+from bigdl_tpu.models.resnet.model import *  # noqa: F401,F403

@@ -1,0 +1,74 @@
+"""ResNet CIFAR-10 training main (reference models/resnet/Train.scala).
+
+Run: ``python -m bigdl_tpu.models.resnet.train -f <cifar10_binary_dir>``.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train ResNet on CIFAR-10")
+    parser.add_argument("--depth", type=int, default=20)
+    parser.add_argument("--shortcutType", default="A")
+    parser.add_argument("--nesterov", action="store_true", default=True)
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import cifar
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgRdmCropper,
+                                         BGRImgToBatch, HFlip)
+    from bigdl_tpu.models import ResNet, model_init
+    from bigdl_tpu.optim import (EpochDecay, Optimizer, SGD, Top1Accuracy,
+                                 every_epoch, max_epoch)
+    from bigdl_tpu.utils import file as bfile
+
+    batch = args.batchSize or 128
+    train = LocalArrayDataSet(cifar.load_folder(args.folder, train=True))
+    val = LocalArrayDataSet(cifar.load_folder(args.folder, train=False))
+    train_set = train >> BGRImgRdmCropper(32, 32, 4) >> HFlip(0.5) \
+        >> BGRImgNormalizer(cifar.TRAIN_MEAN, std_r=cifar.TRAIN_STD) \
+        >> BGRImgToBatch(batch, drop_remainder=True)
+    val_set = val >> BGRImgNormalizer(cifar.TRAIN_MEAN,
+                                      std_r=cifar.TRAIN_STD) \
+        >> BGRImgToBatch(batch)
+
+    if args.model:
+        model = bfile.load_module(args.model)
+    else:
+        model = ResNet(10, {"depth": args.depth,
+                            "shortcutType": args.shortcutType,
+                            "dataset": "cifar10"})
+        model_init(model)   # He init sweep (reference ResNet.modelInit)
+
+    # reference Train.scala: lr 0.1, wd 1e-4, momentum 0.9, nesterov,
+    # lr x0.1 at epochs 81 and 122 (fb.resnet.torch recipe); the exponent
+    # must be traceable since the schedule runs inside the jitted step
+    import jax.numpy as jnp
+
+    def fb_decay(epoch):
+        return jnp.where(epoch >= 122, 2.0,
+                         jnp.where(epoch >= 81, 1.0, 0.0))
+
+    optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.1,
+        weight_decay=1e-4, momentum=0.9, dampening=0.0, nesterov=True,
+        learning_rate_schedule=EpochDecay(fb_decay)))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(every_epoch(), val_set, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 165))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

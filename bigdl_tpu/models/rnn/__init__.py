@@ -1,0 +1,2 @@
+"""rnn model family (reference models/rnn/)."""
+from bigdl_tpu.models.rnn.model import *  # noqa: F401,F403

@@ -1,0 +1,81 @@
+"""SimpleRNN character/word LM training main (reference
+models/rnn/Train.scala — WordTokenizer preprocessing, batchSize=1 padded
+pipeline; SURVEY §5.7).
+
+Run: ``python -m bigdl_tpu.models.rnn.train -f <dir_with_input.txt>``.
+The TPU pipeline pads every sentence to the longest length and keeps the
+batch dimension (BatchedSimpleRNN + TimeDistributedCriterion) so the MXU
+sees real batches instead of the reference's batch-1 worst case.
+"""
+from __future__ import annotations
+
+import os
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train SimpleRNN LM")
+    parser.add_argument("--vocabSize", type=int, default=4000)
+    parser.add_argument("--hiddenSize", type=int, default=40)
+    parser.add_argument("--seqLength", type=int, default=25)
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                        SentenceBiPadding, SentenceSplitter,
+                                        SentenceTokenizer,
+                                        TextToLabeledSentence)
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.models import BatchedSimpleRNN
+    from bigdl_tpu.optim import (Loss, Optimizer, SGD, every_epoch, max_epoch)
+    from bigdl_tpu.utils import file as bfile
+
+    text_path = os.path.join(args.folder, "input.txt")
+    with open(text_path) as f:
+        text = f.read()
+    sentences = list(SentenceSplitter()(iter([text])))
+    tokens = list(SentenceTokenizer()(iter(sentences)))
+    tokens = list(SentenceBiPadding()(iter(tokens)))
+    dictionary = Dictionary(tokens, args.vocabSize)
+    dictionary.save(args.checkpoint or args.folder)
+    vocab = dictionary.get_vocab_size() + 1   # + OOV bucket
+
+    to_sample = TextToLabeledSentence(dictionary) >> LabeledSentenceToSample(
+        vocab, fixed_data_length=args.seqLength,
+        fixed_label_length=args.seqLength)
+    samples = list(to_sample(iter(tokens)))
+    split = max(1, int(len(samples) * 0.8))
+    batch = args.batchSize or 32
+    train_set = LocalArrayDataSet(samples[:split]) >> SampleToBatch(
+        batch, drop_remainder=True)
+    val_set = LocalArrayDataSet(samples[split:] or samples[:1]) \
+        >> SampleToBatch(batch)
+
+    model = (bfile.load_module(args.model) if args.model
+             else BatchedSimpleRNN(vocab, args.hiddenSize, vocab))
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    optimizer = Optimizer(model, train_set, criterion, mesh=mesh)
+    # reference rnn/Train.scala: SGD lr 0.1, decay 0.001, wd 0, momentum 0
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.1,
+        learning_rate_decay=0.001))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(every_epoch(), val_set,
+                             [Loss(criterion.clone_criterion())])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 30))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

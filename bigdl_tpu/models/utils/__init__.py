@@ -1,0 +1,5 @@
+"""Model-zoo utilities: CLI plumbing + perf harnesses (reference
+models/utils/ — DistriOptimizerPerf, LocalOptimizerPerf, ModelBroadcast)."""
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, base_test_parser,
+                                        init_engine, setup_logging)

@@ -1,0 +1,62 @@
+"""Shared CLI plumbing for model Train/Test mains.
+
+Reference parity: the scopt parsers in models/*/Utils.scala /
+models/inception/Options.scala (SURVEY §5.6.4) — common flags -f/--folder,
+-b/--batchSize, --model/--state snapshots, --checkpoint, --overWrite,
+--maxEpoch, --learningRate. The reference's ``--core``/``--node`` topology
+flags become ``--chips`` (mesh size; default = every visible device).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+__all__ = ["base_train_parser", "base_test_parser", "init_engine",
+           "setup_logging"]
+
+
+def setup_logging():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+
+
+def base_train_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--folder", default="./",
+                   help="where the training data lives")
+    p.add_argument("-b", "--batchSize", type=int, default=None,
+                   help="global batch size")
+    p.add_argument("--model", default=None,
+                   help="model snapshot to resume from")
+    p.add_argument("--state", default=None,
+                   help="state snapshot to resume from")
+    p.add_argument("--checkpoint", default=None,
+                   help="where to cache the model/state each epoch")
+    p.add_argument("--overWrite", action="store_true",
+                   help="overwrite existing checkpoint files")
+    p.add_argument("-e", "--maxEpoch", type=int, default=None)
+    p.add_argument("-r", "--learningRate", type=float, default=None)
+    p.add_argument("--chips", type=int, default=None,
+                   help="devices in the mesh (default: all visible)")
+    return p
+
+
+def base_test_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--model", required=True, help="model snapshot path")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    return p
+
+
+def init_engine(chips: int | None = None):
+    """Build the device mesh (reference Engine.init, SURVEY §2.4)."""
+    import jax
+
+    from bigdl_tpu.parallel.engine import Engine
+
+    devs = jax.devices()
+    n = chips or len(devs)
+    Engine.reset()
+    return Engine.init(axes={"data": n}, devices=devs[:n])

@@ -1,0 +1,104 @@
+"""Synthetic-data training throughput harness (reference
+models/utils/DistriOptimizerPerf.scala:33-70 / LocalOptimizerPerf.scala —
+models inception_v1/v2, vgg16/19, random input, records/s per iteration).
+
+Run: ``python -m bigdl_tpu.models.utils.perf -m inception_v1 -b 128``.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+MODELS = {
+    "inception_v1": ("Inception_v1_NoAuxClassifier", 224),
+    "inception_v2": ("Inception_v2_NoAuxClassifier", 224),
+    "vgg16": ("Vgg_16", 224),
+    "vgg19": ("Vgg_19", 224),
+    "alexnet": ("AlexNet_OWT", 224),
+    "resnet50": (lambda models: lambda n: models.ResNet(
+        n, {"depth": 50, "dataset": "imagenet"}), 224),
+    "lenet5": ("LeNet5", 28),
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="training perf harness")
+    parser.add_argument("-m", "--module", default="inception_v1",
+                        choices=sorted(MODELS))
+    parser.add_argument("-b", "--batchSize", type=int, default=128)
+    parser.add_argument("-i", "--iteration", type=int, default=30)
+    parser.add_argument("--warmUp", type=int, default=5)
+    parser.add_argument("--classNum", type=int, default=1000)
+    parser.add_argument("--dataType", default="bf16",
+                        choices=["f32", "bf16"])
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import models, nn
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.tensor import DTypePolicy, set_policy
+
+    if args.dataType == "bf16":
+        set_policy(DTypePolicy(param_dtype=jnp.float32,
+                               compute_dtype=jnp.bfloat16))
+
+    spec, size = MODELS[args.module]
+    if callable(spec):
+        model = spec(models)(args.classNum)
+    else:
+        model = getattr(models, spec)(
+            10 if args.module == "lenet5" else args.classNum)
+    channels = 1 if args.module == "lenet5" else 3
+
+    model.materialize(jax.random.PRNGKey(0))
+    model.training()
+    criterion = nn.ClassNLLCriterion()
+    optim = SGD(learning_rate=0.01, momentum=0.9)
+    params, mstate = model.params, model.state
+    opt_state = optim.init_state(params)
+
+    def step(params, mstate, opt_state, rng, data, labels):
+        def loss_fn(p):
+            y, s = model.apply(p, mstate, data, training=True, rng=rng)
+            return criterion.apply(y, labels), s
+        (loss, s2), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        p2, o2 = optim.update(g, params, opt_state)
+        return p2, s2, o2, loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+    host = np.random.default_rng(0)
+    data = jnp.asarray(host.standard_normal(
+        (args.batchSize, channels, size, size), np.float32))
+    labels = jnp.asarray(host.integers(
+        1, (10 if args.module == "lenet5" else args.classNum) + 1,
+        size=(args.batchSize,)))
+
+    rng = jax.random.PRNGKey(0)
+    for _ in range(args.warmUp):
+        rng, k = jax.random.split(rng)
+        params, mstate, opt_state, loss = jit_step(params, mstate,
+                                                   opt_state, k, data,
+                                                   labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(args.iteration):
+        rng, k = jax.random.split(rng)
+        t1 = time.perf_counter()
+        params, mstate, opt_state, loss = jit_step(params, mstate,
+                                                   opt_state, k, data,
+                                                   labels)
+        print(f"Iteration {i + 1} queued in "
+              f"{time.perf_counter() - t1:.4f}s")
+    float(loss)
+    dt = time.perf_counter() - t0
+    print(f"{args.module}: {args.batchSize * args.iteration / dt:.2f} "
+          f"records/second ({dt / args.iteration * 1000:.2f} ms/iteration)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""vgg model family (reference models/vgg/)."""
+from bigdl_tpu.models.vgg.model import *  # noqa: F401,F403

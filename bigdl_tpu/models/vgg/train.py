@@ -1,0 +1,62 @@
+"""VGG CIFAR-10 training main (reference models/vgg/Train.scala).
+
+Run: ``python -m bigdl_tpu.models.vgg.train -f <cifar10_binary_dir>``.
+Expects data_batch_{1..5}.bin / test_batch.bin under ``--folder``.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train VGG on CIFAR-10")
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import cifar
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import (BGRImgNormalizer, BGRImgRdmCropper,
+                                         BGRImgToBatch, HFlip)
+    from bigdl_tpu.models import VggForCifar10
+    from bigdl_tpu.optim import (EpochStep, Optimizer, SGD, Top1Accuracy,
+                                 every_epoch, max_epoch)
+    from bigdl_tpu.utils import file as bfile
+
+    batch = args.batchSize or 128
+    train = LocalArrayDataSet(cifar.load_folder(args.folder, train=True))
+    val = LocalArrayDataSet(cifar.load_folder(args.folder, train=False))
+
+    # reference Train.scala pipeline: crop(32,32,pad 4) -> hflip(0.5) ->
+    # normalize(trainMean, trainStd) -> batch
+    train_set = train >> BGRImgRdmCropper(32, 32, 4) >> HFlip(0.5) \
+        >> BGRImgNormalizer(cifar.TRAIN_MEAN, std_r=cifar.TRAIN_STD) \
+        >> BGRImgToBatch(batch, drop_remainder=True)
+    val_set = val >> BGRImgNormalizer(cifar.TRAIN_MEAN,
+                                      std_r=cifar.TRAIN_STD) \
+        >> BGRImgToBatch(batch)
+
+    model = (bfile.load_module(args.model) if args.model
+             else VggForCifar10(class_num=10))
+    optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
+    # reference: SGD lr 0.01, decay 0, wd 0.0005, momentum 0.9,
+    # EpochStep(25, 0.5)
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.01,
+        weight_decay=0.0005, momentum=0.9,
+        learning_rate_schedule=EpochStep(25, 0.5)))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(every_epoch(), val_set, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 90))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

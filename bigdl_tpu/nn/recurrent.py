@@ -58,7 +58,11 @@ class RnnCell(Cell):
                  activation: str = "tanh"):
         super().__init__()
         self.input_size, self.hidden_size = input_size, hidden_size
-        self.act = _ACT[activation]
+        self.activation = activation  # name, so the module pickles
+
+    @property
+    def act(self):
+        return _ACT[self.activation]
 
     def hid_shape(self, batch):
         return (batch, self.hidden_size)

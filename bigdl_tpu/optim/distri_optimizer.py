@@ -123,6 +123,19 @@ class DistriOptimizer(Optimizer):
                                                      batch_shard),
                            out_shardings=batch_shard)
 
+        def eval_fn(p, s, d):
+            # pad remainder batches up to a multiple of the mesh size, then
+            # trim (validation sets need not divide the mesh — the
+            # reference's per-partition eval had the same freedom,
+            # DistriValidator.scala:38-78)
+            d = np.asarray(d)
+            n = d.shape[0]
+            pad = (-n) % n_shards
+            if pad:
+                d = np.concatenate([d, np.repeat(d[-1:], pad, axis=0)])
+            out = jit_eval(p, s, jax.device_put(d, batch_shard))
+            return np.asarray(out)[:n]
+
         rng = jax.random.PRNGKey(int(self.state.get("seed", 0)))
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
@@ -169,10 +182,7 @@ class DistriOptimizer(Optimizer):
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
             model.sync(params, mstate)
-            self._validate(
-                lambda p, s, d: jit_eval(
-                    p, s, jax.device_put(np.asarray(d), batch_shard)),
-                params, mstate, driver_state)
+            self._validate(eval_fn, params, mstate, driver_state)
             self._checkpoint(driver_state)
 
         model.sync(params, mstate)

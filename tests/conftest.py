@@ -20,3 +20,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_host_rng():
+    """Host-side RNG is process-global (reference RandomGenerator thread-local
+    singleton); reseed per test so shuffle-order-sensitive tests are
+    isolated from tests that reseed it."""
+    from bigdl_tpu.utils.random import RandomGenerator
+    RandomGenerator.set_seed(1)
+    yield

@@ -1,0 +1,205 @@
+"""Image/text pipeline tests (mirrors reference dataset/ specs — SURVEY §4.6)."""
+import gzip
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import mnist, cifar
+from bigdl_tpu.dataset.image import (
+    BGRImgCropper, BGRImgNormalizer, BGRImgRdmCropper, BGRImgToBatch,
+    BytesToBGRImg, ColorJitter, CropCenter, GreyImgNormalizer, GreyImgToBatch,
+    HFlip, LabeledBGRImage, LabeledGreyImage, Lighting, MTImgToBatch)
+from bigdl_tpu.dataset.sample import ByteRecord
+from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                    SentenceBiPadding, SentenceSplitter,
+                                    SentenceTokenizer, SentenceToken,
+                                    TextToLabeledSentence)
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+def bgr_images(n=4, h=8, w=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [LabeledBGRImage(rng.random((h, w, 3), np.float32), float(i + 1))
+            for i in range(n)]
+
+
+class TestImageTransforms:
+    def test_center_crop(self):
+        imgs = bgr_images(h=10, w=12)
+        out = list(BGRImgCropper(8, 8, CropCenter)(iter(imgs)))
+        assert all(o.content.shape == (8, 8, 3) for o in out)
+        # center crop is deterministic: top-left (1, 2)
+        np.testing.assert_array_equal(out[0].content,
+                                      bgr_images(h=10, w=12)[0].content[1:9, 2:10])
+
+    def test_random_crop_bounds(self):
+        RandomGenerator.set_seed(7)
+        imgs = bgr_images(h=10, w=10)
+        out = list(BGRImgCropper(8, 8)(iter(imgs)))
+        assert all(o.content.shape == (8, 8, 3) for o in out)
+
+    def test_padded_random_crop(self):
+        RandomGenerator.set_seed(7)
+        imgs = bgr_images(h=32, w=32)
+        out = list(BGRImgRdmCropper(32, 32, padding=4)(iter(imgs)))
+        assert all(o.content.shape == (32, 32, 3) for o in out)
+
+    def test_normalizer_channel_order(self):
+        img = LabeledBGRImage(np.zeros((2, 2, 3), np.float32), 1.0)
+        img.content[..., 2] = 1.0   # R channel = 1
+        out = next(iter(BGRImgNormalizer(1.0, 0.0, 0.0, 1.0, 1.0, 1.0)(
+            iter([img]))))
+        # R channel had mean 1 -> now 0; B,G untouched
+        np.testing.assert_allclose(out.content[..., 2], 0.0)
+        np.testing.assert_allclose(out.content[..., 0], 0.0)
+
+    def test_normalizer_fit(self):
+        from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+        imgs = bgr_images(n=6)
+        norm = BGRImgNormalizer.fit(LocalArrayDataSet(imgs))
+        out = np.stack([o.content for o in
+                        norm(iter([i.clone() for i in imgs]))])
+        assert abs(out.mean()) < 1e-4 and abs(out.std() - 1) < 0.05
+
+    def test_hflip(self):
+        img = bgr_images(1)[0]
+        orig = img.content.copy()
+        out = next(iter(HFlip(threshold=1.0)(iter([img]))))
+        np.testing.assert_array_equal(out.content, orig[:, ::-1])
+
+    def test_lighting_shifts_channels_uniformly(self):
+        img = LabeledBGRImage(np.zeros((3, 3, 3), np.float32), 1.0)
+        out = next(iter(Lighting()(iter([img]))))
+        # every pixel gets the same per-channel shift
+        assert np.unique(out.content.reshape(-1, 3), axis=0).shape[0] == 1
+
+    def test_color_jitter_preserves_shape(self):
+        RandomGenerator.set_seed(3)
+        out = list(ColorJitter()(iter(bgr_images())))
+        assert all(o.content.shape == (8, 8, 3) for o in out)
+        assert all(o.content.dtype == np.float32 for o in out)
+
+    def test_bgr_to_batch_nchw(self):
+        batches = list(BGRImgToBatch(3)(iter(bgr_images(7))))
+        assert batches[0].data.shape == (3, 3, 8, 8)
+        assert batches[-1].data.shape == (1, 3, 8, 8)   # remainder
+        np.testing.assert_array_equal(batches[0].labels, [1.0, 2.0, 3.0])
+
+    def test_grey_to_batch(self):
+        imgs = [LabeledGreyImage(np.ones((5, 5), np.float32), 1.0)] * 4
+        b = next(iter(GreyImgToBatch(4)(iter(imgs))))
+        assert b.data.shape == (4, 1, 5, 5)
+
+    def test_decode_bytes(self):
+        from PIL import Image
+        arr = np.zeros((4, 4, 3), np.uint8)
+        arr[..., 0] = 255  # pure red
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, "PNG")
+        rec = ByteRecord(buf.getvalue(), 3.0)
+        img = next(iter(BytesToBGRImg()(iter([rec]))))
+        assert img.content.shape == (4, 4, 3)
+        np.testing.assert_allclose(img.content[..., 2], 1.0)  # R at BGR idx 2
+        assert img.label == 3.0
+
+    def test_mt_batch_matches_serial(self):
+        imgs = bgr_images(n=20)
+        inner = BGRImgNormalizer(0.5, 0.5, 0.5, 1.0, 1.0, 1.0)
+        serial = list(BGRImgToBatch(4, drop_remainder=True)(
+            inner(iter([i.clone() for i in imgs]))))
+        mt = list(MTImgToBatch(4, inner, num_threads=3)(
+            iter([i.clone() for i in imgs])))
+        assert sum(b.data.shape[0] for b in mt) == 20
+        # content set must match regardless of batch order
+        key = lambda b: tuple(np.sort(b.data.reshape(-1))[:5])
+        all_serial = np.sort(np.concatenate(
+            [b.data.reshape(-1) for b in serial]))
+        all_mt = np.sort(np.concatenate([b.data.reshape(-1) for b in mt]))
+        np.testing.assert_allclose(all_serial, all_mt[:all_serial.size])
+
+
+class TestMnistCifar:
+    def test_mnist_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (10, 28, 28), np.uint8)
+        labels = rng.integers(0, 10, 10, np.uint8)
+        img_file = tmp_path / "images.gz"
+        with gzip.open(img_file, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+            f.write(imgs.tobytes())
+        lab_file = tmp_path / "labels.gz"
+        with gzip.open(lab_file, "wb") as f:
+            f.write(struct.pack(">II", 2049, 10))
+            f.write(labels.tobytes())
+        data = mnist.load(str(img_file), str(lab_file))
+        assert len(data) == 10
+        np.testing.assert_allclose(data[0].content, imgs[0] / 255.0)
+        assert data[0].label == labels[0] + 1.0  # 1-based
+
+    def test_cifar_record_layout(self, tmp_path):
+        rec = np.zeros(3073, np.uint8)
+        rec[0] = 2                      # label
+        rec[1:1025] = 10                # R plane
+        rec[1025:2049] = 20             # G plane
+        rec[2049:3073] = 30             # B plane
+        p = tmp_path / "data_batch_1.bin"
+        p.write_bytes(rec.tobytes())
+        img = cifar.load_bin(str(p))[0]
+        assert img.label == 3.0         # 1-based
+        np.testing.assert_allclose(img.content[..., 0], 30)  # B first
+        np.testing.assert_allclose(img.content[..., 2], 10)  # R last
+
+
+class TestTextTransforms:
+    def test_splitter_tokenizer(self):
+        text = ["Hello world. How are you? Fine!"]
+        sents = list(SentenceSplitter()(iter(text)))
+        assert len(sents) == 3
+        toks = list(SentenceTokenizer()(iter(sents)))
+        assert toks[0] == ["hello", "world", "."]
+
+    def test_bipadding(self):
+        out = next(iter(SentenceBiPadding()(iter([["a", "b"]]))))
+        assert out == [SentenceToken.start, "a", "b", SentenceToken.end]
+
+    def test_dictionary_ranking_and_oov(self):
+        d = Dictionary([["a", "b", "a"], ["a", "c", "b"]], vocab_size=2)
+        assert d.get_vocab_size() == 2
+        assert d.get_index("a") == 0           # most frequent
+        assert d.get_index("b") == 1
+        assert d.get_index("c") == 2           # OOV -> vocab_size
+        assert d.get_index("zzz") == 2
+        assert d.get_discard_size() == 1
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = Dictionary([["x", "y", "x"]], vocab_size=5)
+        d.save(str(tmp_path))
+        d2 = Dictionary.load(str(tmp_path))
+        assert d2.word2index() == d.word2index()
+        assert d2.get_word(0) == d.get_word(0)
+
+    def test_lm_pipeline_end_to_end(self):
+        sents = ["the cat sat", "the dog sat"]
+        tok = SentenceTokenizer()
+        toks = list(tok(iter(sents)))
+        d = Dictionary(toks, vocab_size=10)
+        pipeline = SentenceBiPadding() >> TextToLabeledSentence(d) >> \
+            LabeledSentenceToSample(d.get_vocab_size() + 1)
+        samples = list(pipeline(iter(toks)))
+        assert len(samples) == 2
+        s = samples[0]
+        # 5 tokens (incl start/end) -> 4 LM steps
+        assert s.feature.shape == (4, d.get_vocab_size() + 1)
+        np.testing.assert_allclose(s.feature.sum(-1), 1.0)  # one-hot
+        assert s.label.shape == (4,)
+        assert s.label.min() >= 1.0   # 1-based for ClassNLL
+
+    def test_fixed_length_padding(self):
+        d = Dictionary([["a", "b", "c", "d"]], vocab_size=10)
+        pipe = TextToLabeledSentence(d) >> LabeledSentenceToSample(
+            11, fixed_data_length=6, fixed_label_length=6)
+        s = next(iter(pipe(iter([["a", "b", "c", "d"]]))))
+        assert s.feature.shape == (6, 11)
+        assert s.label.shape == (6,)

@@ -159,7 +159,7 @@ class TestLocalOptimizer:
         o = optim.Optimizer(model=model, dataset=ds,
                             criterion=nn.ClassNLLCriterion())
         o.set_optim_method(optim.Adagrad(learning_rate=0.3)) \
-         .set_end_when(optim.max_epoch(40))
+         .set_end_when(optim.max_epoch(80))
         trained = o.optimize()
         res = optim.LocalValidator(
             trained, array(make_xor_dataset(seed=5)) >> SampleToBatch(64)
