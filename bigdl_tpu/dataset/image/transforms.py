@@ -74,10 +74,12 @@ class BytesToGreyImg(Transformer):
 
 
 class LocalImgReader(Transformer):
-    """(path, label) -> LabeledBGRImage, optional resize keeping aspect so
-    the shorter side == ``scale_to`` (reference LocalImgReader.scala)."""
+    """(path, label) -> LabeledBGRImage. ``scale_to`` as an int resizes
+    keeping aspect so the shorter side matches (reference
+    LocalImgReader.scala); a ``(width, height)`` tuple resizes exactly
+    (the reference's two-arg overload used by AlexNetPreprocessor)."""
 
-    def __init__(self, scale_to: int | None = None, normalize: float = 255.0):
+    def __init__(self, scale_to=None, normalize: float = 255.0):
         self.scale_to = scale_to
         self.normalize = normalize
 
@@ -85,7 +87,9 @@ class LocalImgReader(Transformer):
         from PIL import Image
         for path, label in it:
             img = Image.open(path).convert("RGB")
-            if self.scale_to is not None:
+            if isinstance(self.scale_to, (tuple, list)):
+                img = img.resize(tuple(self.scale_to), Image.BILINEAR)
+            elif self.scale_to is not None:
                 w, h = img.size
                 if w < h:
                     nw, nh = self.scale_to, int(h * self.scale_to / w)
@@ -135,8 +139,7 @@ class _Cropper(Transformer):
         for img in it:
             h, w = img.content.shape[:2]
             y, x = self._offsets(h, w)
-            img.content = img.content[y:y + self.ch, x:x + self.cw]
-            yield img
+            yield img.with_content(img.content[y:y + self.ch, x:x + self.cw])
 
 
 class BGRImgCropper(_Cropper):
@@ -162,8 +165,7 @@ class BGRImgRdmCropper(Transformer):
             h, w = c.shape[:2]
             y = int(rng.random_int(0, h - self.ch + 1))
             x = int(rng.random_int(0, w - self.cw + 1))
-            img.content = c[y:y + self.ch, x:x + self.cw]
-            yield img
+            yield img.with_content(c[y:y + self.ch, x:x + self.cw])
 
 
 class BGRImgNormalizer(Transformer):
@@ -204,8 +206,7 @@ class BGRImgNormalizer(Transformer):
 
     def __call__(self, it):
         for img in it:
-            img.content = (img.content - self.mean) / self.std
-            yield img
+            yield img.with_content((img.content - self.mean) / self.std)
 
 
 class GreyImgNormalizer(Transformer):
@@ -230,8 +231,7 @@ class GreyImgNormalizer(Transformer):
 
     def __call__(self, it):
         for img in it:
-            img.content = (img.content - self.mean) / self.std
-            yield img
+            yield img.with_content((img.content - self.mean) / self.std)
 
 
 class BGRImgPixelNormalizer(Transformer):
@@ -243,8 +243,8 @@ class BGRImgPixelNormalizer(Transformer):
 
     def __call__(self, it):
         for img in it:
-            img.content = img.content - self.means.reshape(img.content.shape)
-            yield img
+            yield img.with_content(
+                img.content - self.means.reshape(img.content.shape))
 
 
 class HFlip(Transformer):
@@ -258,8 +258,9 @@ class HFlip(Transformer):
         rng = RandomGenerator.RNG()
         for img in it:
             if rng.uniform() < self.threshold:
-                img.content = img.content[:, ::-1].copy()
-            yield img
+                yield img.with_content(img.content[:, ::-1].copy())
+            else:
+                yield img
 
 
 class ColorJitter(Transformer):
@@ -296,8 +297,8 @@ class ColorJitter(Transformer):
     def __call__(self, it):
         rng = RandomGenerator.RNG()
         for img in it:
-            img.content = self._jitter(img.content, rng).astype(np.float32)
-            yield img
+            yield img.with_content(
+                self._jitter(img.content, rng).astype(np.float32))
 
 
 class Lighting(Transformer):
@@ -318,8 +319,7 @@ class Lighting(Transformer):
             alpha = rng.uniform(0, self.ALPHASTD, 3).astype(np.float32)
             rgb = (self.EIGVEC * alpha[None, :] *
                    self.EIGVAL[None, :]).sum(1)
-            img.content = img.content + rgb[::-1][None, None, :]
-            yield img
+            yield img.with_content(img.content + rgb[::-1][None, None, :])
 
 
 class _ToBatch(Transformer):
@@ -377,6 +377,7 @@ class MTImgToBatch(Transformer):
         self.num_threads = num_threads
         self.prefetch = prefetch
         self.to_chw = to_chw
+        self._invocation = 0
 
     def _assemble(self, records):
         feats, labels = [], []
@@ -391,15 +392,20 @@ class MTImgToBatch(Transformer):
     def __call__(self, it):
         out_q: "queue.Queue" = queue.Queue(maxsize=max(1, self.prefetch))
         stop = object()
+        invocation = self._invocation
+        self._invocation += 1
 
         def producer():
             try:
                 workers = [self.inner.clone_transformer()
                            for _ in range(self.num_threads)]
                 lock = threading.Lock()
-                batch_records: list = []
+                seq_counter = [0]
 
                 def pull_chunk():
+                    """Claim the next chunk under the lock: (seq, records).
+                    Chunks are full batch_size except the final one, so
+                    at most one short tail batch is ever emitted."""
                     with lock:
                         chunk = []
                         try:
@@ -407,32 +413,43 @@ class MTImgToBatch(Transformer):
                                 chunk.append(next(it))
                         except StopIteration:
                             pass
-                        return chunk
+                        seq = seq_counter[0]
+                        if chunk:
+                            seq_counter[0] += 1
+                        return seq, chunk
 
-                # simple pipelined chunks: each worker transforms a chunk,
-                # results are emitted as batches in claim order
                 claim_q: "queue.Queue" = queue.Queue()
 
-                def worker(w):
+                def worker(widx, w):
+                    RandomGenerator.seed_worker(widx, invocation)
                     while True:
-                        chunk = pull_chunk()
+                        seq, chunk = pull_chunk()
                         if not chunk:
-                            claim_q.put(stop)
+                            claim_q.put((None, stop))
                             return
-                        claim_q.put(list(w(iter(chunk))))
+                        claim_q.put((seq, list(w(iter(chunk)))))
 
-                threads = [threading.Thread(target=worker, args=(w,),
-                                            daemon=True) for w in workers]
+                threads = [threading.Thread(target=worker, args=(i, w),
+                                            daemon=True)
+                           for i, w in enumerate(workers)]
                 for t in threads:
                     t.start()
+                # emit strictly in claim order (reference emits batches in
+                # slot-claim order, MTLabeledBGRImgToBatch.scala:46-103)
+                pending: dict = {}
+                next_seq = 0
                 finished = 0
                 while finished < self.num_threads:
-                    got = claim_q.get()
+                    seq, got = claim_q.get()
                     if got is stop:
                         finished += 1
                         continue
-                    if got:
-                        out_q.put(self._assemble(got))
+                    pending[seq] = got
+                    while next_seq in pending:
+                        out_q.put(self._assemble(pending.pop(next_seq)))
+                        next_seq += 1
+                for seq in sorted(pending):
+                    out_q.put(self._assemble(pending[seq]))
                 for t in threads:
                     t.join()
             finally:

@@ -36,6 +36,16 @@ class LabeledImage:
     def clone(self):
         return type(self)(self.content.copy(), self.label)
 
+    def with_content(self, content: np.ndarray) -> "LabeledImage":
+        """New carrier around ``content`` with the same label. Transformers
+        must yield fresh carriers instead of rebinding ``content`` on the
+        input — sources cache decoded images across epochs, so in-place
+        rebinding would compound transforms every pass."""
+        out = type(self).__new__(type(self))
+        out.content = np.asarray(content, np.float32)
+        out.label = self.label
+        return out
+
     def __repr__(self):
         return (f"{type(self).__name__}(shape={self.content.shape}, "
                 f"label={self.label})")

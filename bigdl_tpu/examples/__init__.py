@@ -1,0 +1,2 @@
+"""Integrated example apps (reference dl/.../bigdl/example/ — SURVEY §2.10):
+textclassification, imageclassification, loadmodel."""

@@ -1,0 +1,2 @@
+from bigdl_tpu.examples.loadmodel.dataset_util import (
+    AlexNetPreprocessor, InceptionPreprocessor, ResNetPreprocessor)

@@ -1,0 +1,55 @@
+"""Validation-set preprocessors for imported models (reference
+example/loadmodel/DatasetUtil.scala:18-80).
+
+Each builds: image-folder paths -> decode/resize -> normalize -> center
+crop -> NCHW batches, with the published per-model recipes.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                     BGRImgPixelNormalizer, BGRImgToBatch,
+                                     CropCenter, LocalImageFiles,
+                                     LocalImgReader)
+
+__all__ = ["AlexNetPreprocessor", "InceptionPreprocessor",
+           "ResNetPreprocessor"]
+
+
+def _paths_dataset(folder: str):
+    return LocalArrayDataSet(LocalImageFiles.paths(folder))
+
+
+def AlexNetPreprocessor(path: str, batch_size: int, mean_file: str):
+    """227 center crop over exact 256x256 resize, per-pixel mean subtract,
+    raw 0-255 pixel range (reference DatasetUtil.scala:28-42)."""
+    means = np.load(mean_file)
+    return (_paths_dataset(str(path))
+            >> LocalImgReader((256, 256), normalize=1.0)
+            >> BGRImgPixelNormalizer(means)
+            >> BGRImgCropper(227, 227, CropCenter)
+            >> BGRImgToBatch(batch_size))
+
+
+def InceptionPreprocessor(path: str, batch_size: int):
+    """224 center crop, mean (123,117,104) subtract, raw pixel range
+    (reference DatasetUtil.scala:45-59)."""
+    return (_paths_dataset(str(path))
+            >> LocalImgReader((256, 256), normalize=1.0)
+            >> BGRImgCropper(224, 224, CropCenter)
+            >> BGRImgNormalizer(123, 117, 104, 1, 1, 1)
+            >> BGRImgToBatch(batch_size))
+
+
+def ResNetPreprocessor(path: str, batch_size: int):
+    """Shorter-side-256 resize, 224 center crop, ImageNet mean/std on [0,1]
+    pixels (reference DatasetUtil.scala:62-80)."""
+    return (_paths_dataset(str(path))
+            >> LocalImgReader(256)
+            >> BGRImgCropper(224, 224, CropCenter)
+            >> BGRImgNormalizer(0.485, 0.456, 0.406, 0.229, 0.224, 0.225)
+            >> BGRImgToBatch(batch_size))

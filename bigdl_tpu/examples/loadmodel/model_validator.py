@@ -1,0 +1,101 @@
+"""ModelValidator — load an imported (Caffe/Torch/BigDL) model and test it
+over an ImageNet-style validation folder.
+
+Reference parity: example/loadmodel/ModelValidator.scala — model-type
+dispatch (caffe: alexnet/inception; torch: resnet; bigdl: any snapshot),
+per-model preprocessor, Top1+Top5 over a Validator.
+
+Run::
+
+    python -m bigdl_tpu.examples.loadmodel.model_validator \
+        -t caffe -m alexnet --caffeDefPath deploy.prototxt \
+        --modelPath bvlc_alexnet.caffemodel --meanFile mean.npy -f <dir>
+
+``-f`` points at a folder with a ``val/`` class-per-subfolder tree.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+from pathlib import Path
+
+logger = logging.getLogger("bigdl_tpu.examples.loadmodel")
+
+__all__ = ["build_model_and_data", "main"]
+
+
+def build_model_and_data(args):
+    """Model-type dispatch (reference ModelValidator.scala:125-147)."""
+    from bigdl_tpu.examples.loadmodel.dataset_util import (
+        AlexNetPreprocessor, InceptionPreprocessor, ResNetPreprocessor)
+
+    val_path = str(Path(args.folder) / "val")
+    name = args.modelName.lower()
+    mtype = args.modelType.lower()
+    if mtype == "caffe":
+        from bigdl_tpu.utils.caffe import load_caffe
+        if name == "alexnet":
+            from bigdl_tpu.models.alexnet import AlexNet
+            model = load_caffe(AlexNet(1000), args.caffeDefPath,
+                               args.modelPath)
+            data = AlexNetPreprocessor(val_path, args.batchSize,
+                                       args.meanFile)
+        elif name == "inception":
+            from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+            model = load_caffe(Inception_v1_NoAuxClassifier(1000),
+                               args.caffeDefPath, args.modelPath)
+            data = InceptionPreprocessor(val_path, args.batchSize)
+        elif name == "resnet":
+            from bigdl_tpu.models.resnet import ResNet
+            model = load_caffe(
+                ResNet(1000, {"depth": args.depth, "shortcutType": "B",
+                              "dataset": "imagenet"}),
+                args.caffeDefPath, args.modelPath, match_all=False)
+            data = ResNetPreprocessor(val_path, args.batchSize)
+        else:
+            raise ValueError(
+                "caffe type supports alexnet/inception/resnet, got " + name)
+    elif mtype == "torch":
+        from bigdl_tpu.utils.torchfile import load_torch
+        model = load_torch(args.modelPath)
+        data = ResNetPreprocessor(val_path, args.batchSize)
+    elif mtype == "bigdl":
+        from bigdl_tpu.utils import file as bfile
+        model = bfile.load_module(args.modelPath)
+        data = ResNetPreprocessor(val_path, args.batchSize)
+    else:
+        raise ValueError("only torch, caffe or bigdl supported")
+    return model, data
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("bigdl_tpu Image Classifier Example")
+    p.add_argument("-f", "--folder", default="./",
+                   help="folder holding the val/ image tree")
+    p.add_argument("-m", "--modelName", required=True,
+                   help="alexnet | inception | resnet")
+    p.add_argument("-t", "--modelType", required=True,
+                   help="torch | caffe | bigdl")
+    p.add_argument("--caffeDefPath", default=None)
+    p.add_argument("--modelPath", default="")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--meanFile", default=None,
+                   help=".npy per-pixel mean (alexnet)")
+    p.add_argument("--depth", type=int, default=50,
+                   help="resnet depth for caffe resnet import")
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy, Validator
+
+    model, data = build_model_and_data(args)
+    print(model)
+    validator = Validator(model, data)
+    results = validator.test([Top1Accuracy(), Top5Accuracy()])
+    for res, method in results:
+        logger.info("%s is %s", method, res)
+    return results
+
+
+if __name__ == "__main__":
+    main()

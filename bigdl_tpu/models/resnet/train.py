@@ -4,6 +4,8 @@ Run: ``python -m bigdl_tpu.models.resnet.train -f <cifar10_binary_dir>``.
 """
 from __future__ import annotations
 
+import argparse
+
 from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
                                         setup_logging)
 
@@ -13,7 +15,8 @@ def main(argv=None):
     parser = base_train_parser("Train ResNet on CIFAR-10")
     parser.add_argument("--depth", type=int, default=20)
     parser.add_argument("--shortcutType", default="A")
-    parser.add_argument("--nesterov", action="store_true", default=True)
+    parser.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
+                        default=True)
     args = parser.parse_args(argv)
     mesh = init_engine(args.chips)
 
@@ -57,7 +60,8 @@ def main(argv=None):
     optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
     optimizer.set_optim_method(SGD(
         learning_rate=args.learningRate or 0.1,
-        weight_decay=1e-4, momentum=0.9, dampening=0.0, nesterov=True,
+        weight_decay=1e-4, momentum=0.9, dampening=0.0,
+        nesterov=args.nesterov,
         learning_rate_schedule=EpochDecay(fb_decay)))
     if args.state:
         optimizer.set_state(bfile.load(args.state))

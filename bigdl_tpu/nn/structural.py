@@ -84,11 +84,16 @@ class View(Module):
         n = int(np.prod([s for s in self.sizes if s > 0]))
         if self.num_input_dims is not None:
             batched = x.ndim > self.num_input_dims
+        elif -1 in self.sizes:
+            # -1 absorbs any element count, so the non-batched reshape is
+            # always valid; without num_input_dims a bare View(-1) is the
+            # Torch full-flatten, never an implicit batch split
+            batched = False
         else:
             # treat dim 0 as batch whenever the target accounts for the rest
-            batched = x.ndim > len(self.sizes) and \
-                x.size == x.shape[0] * n and -1 not in self.sizes
-        if batched or (x.size != n and -1 not in self.sizes) or -1 in self.sizes:
+            batched = (x.ndim > len(self.sizes)
+                       and x.size == x.shape[0] * n) or x.size != n
+        if batched:
             return x.reshape((x.shape[0],) + self.sizes), state
         return x.reshape(self.sizes), state
 

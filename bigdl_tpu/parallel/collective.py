@@ -20,7 +20,14 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+try:                                   # jax >= 0.8
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:                    # older jax
+    from jax.experimental.shard_map import shard_map
 
 from bigdl_tpu.parallel.engine import get_mesh
 
@@ -34,27 +41,41 @@ def _wire(x, wire_dtype):
 
 def all_reduce(x, axis: str = "data", mesh: Mesh | None = None, *,
                mean: bool = False, wire_dtype=None):
-    """Sum (or mean) ``x`` across ``axis``; every shard gets the result.
+    """Reduce N per-shard contributions across ``axis``.
+
+    ``x`` is the STACK of contributions: leading dim == mesh.shape[axis],
+    ``x[i]`` being what shard ``i`` contributes (the eager emulation of N
+    parties each calling the collective with their own value). Returns the
+    elementwise sum (or mean) of the blocks, shape ``x.shape[1:]``,
+    replicated on every shard.
 
     Equivalent of the reference's putGradients+aggregate+getWeights round
-    trip collapsed into one ``lax.psum``.
+    trip collapsed into one ``lax.psum``. A replicated input with
+    ``in_specs=P()`` would make psum count the same value N times — the
+    stacked contract keeps the sum honest.
     """
     mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    if x.ndim == 0 or x.shape[0] != n:
+        raise ValueError(
+            f"all_reduce wants stacked per-shard contributions: leading dim "
+            f"{x.shape[0] if x.ndim else '<scalar>'} != mesh axis "
+            f"'{axis}' size {n}")
     orig_dtype = x.dtype
 
     def body(v):
-        v = _wire(v, wire_dtype)
+        v = _wire(v[0], wire_dtype)
         out = jax.lax.pmean(v, axis) if mean else jax.lax.psum(v, axis)
         return out.astype(orig_dtype)
 
-    spec = P()  # replicated value per shard
-    return shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+    return shard_map(body, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
                      check_rep=False)(x)
 
 
 def psum_tree(tree, axis: str = "data", mesh: Mesh | None = None, *,
               mean: bool = False, wire_dtype=None):
-    """all_reduce over every leaf of a pytree (flat-gradient equivalent)."""
+    """all_reduce over every leaf of a pytree; each leaf carries the stacked
+    per-shard leading dim (flat-gradient equivalent)."""
     return jax.tree.map(
         lambda v: all_reduce(v, axis, mesh, mean=mean,
                              wire_dtype=wire_dtype), tree)
@@ -82,18 +103,32 @@ def all_gather(x, axis: str = "data", mesh: Mesh | None = None,
 
 
 def reduce_scatter(x, axis: str = "data", mesh: Mesh | None = None, *,
-                   wire_dtype=None):
-    """Sum across shards, each shard keeps its slice of dim 0 (reference
-    putGradients + aggregrateGradientPartition, :161-215)."""
+                   mean: bool = False, wire_dtype=None):
+    """Sum N per-shard contributions; each shard keeps its slice (reference
+    putGradients + aggregrateGradientPartition, :161-215).
+
+    ``x`` is the stack of contributions, shape ``(N, S, ...)`` with
+    ``N == mesh.shape[axis]`` — shard ``i`` contributes ``x[i]``. Returns
+    the elementwise sum (or mean), shape ``(S, ...)``, sharded over dim 0
+    along ``axis`` (each shard owns ``S/N`` rows).
+    """
     mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    if x.ndim == 0 or x.shape[0] != n:
+        raise ValueError(
+            f"reduce_scatter wants stacked per-shard contributions: leading "
+            f"dim {x.shape[0] if x.ndim else '<scalar>'} != mesh axis "
+            f"'{axis}' size {n}")
     orig_dtype = x.dtype
 
     def body(v):
-        v = _wire(v, wire_dtype)
+        v = _wire(v[0], wire_dtype)
         out = jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+        if mean:
+            out = out / n
         return out.astype(orig_dtype)
 
-    return shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P(axis),
+    return shard_map(body, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
                      check_rep=False)(x)
 
 

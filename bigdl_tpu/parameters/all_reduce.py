@@ -54,9 +54,21 @@ class AllReduceParameter:
         self._unravel = None
 
     # -- canonical fused path (what DistriOptimizer compiles) --
-    def all_reduce_gradients(self, grads, *, mean: bool = True):
-        """One fused collective for a gradient pytree — inside a jitted
-        step this lowers to the backward-pass allreduce."""
+    def all_reduce_gradients(self, per_shard_grads, *, mean: bool = True):
+        """Reduce per-shard gradient pytrees into one global gradient.
+
+        ``per_shard_grads``: a sequence of N gradient trees (one per mesh
+        shard along ``axis``). Returns the mean (or sum) tree, replicated.
+        A single tree is rejected — leaves whose leading dim happens to
+        equal the mesh size would be silently mis-reduced. Note
+        DistriOptimizer doesn't need this — its allreduce is induced by
+        batch sharding inside the jitted step; this is the eager emulation
+        of the reference's N-party protocol."""
+        if not isinstance(per_shard_grads, (list, tuple)):
+            raise ValueError(
+                "all_reduce_gradients wants a sequence of N per-shard "
+                "gradient trees (one per mesh shard), not a single tree")
+        grads = jax.tree.map(lambda *ls: jnp.stack(ls), *per_shard_grads)
         return C.psum_tree(grads, self.axis, self.mesh, mean=mean,
                            wire_dtype=self.wire_dtype)
 
@@ -68,21 +80,37 @@ class AllReduceParameter:
         self._unravel = unravel
         return flat
 
-    def _padded(self, flat):
-        pad = (-flat.size) % self.partition_num
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
-        return flat
+    def put_gradients(self, per_shard_grads, *, mean: bool = False):
+        """reduce-scatter per-shard gradients: each mesh shard ends up
+        owning the SUM (or mean) of its slice of the N distinct
+        contributions (reference putGradients +
+        aggregrateGradientPartition collapsed, :161-215).
 
-    def put_gradients(self, grad_tree_or_flat):
-        """reduce-scatter the flat gradient: each mesh shard ends up owning
-        the SUM of its slice (reference putGradients +
-        aggregrateGradientPartition collapsed, :161-215). Returns the
-        sharded flat gradient."""
-        flat = grad_tree_or_flat
-        if not isinstance(flat, jnp.ndarray) or flat.ndim != 1:
-            flat, _ = flatten_params(grad_tree_or_flat)
-        return C.reduce_scatter(self._padded(flat), self.axis, self.mesh,
+        ``per_shard_grads``: a sequence of N gradient trees / flat vectors
+        (one per shard), or a pre-stacked ``(N, S)`` array. Returns the
+        sharded flat gradient of global shape ``(S,)``."""
+        grads = per_shard_grads
+        if isinstance(grads, (list, tuple)):
+            flats = []
+            for g in grads:
+                if not (hasattr(g, "ndim") and g.ndim == 1):
+                    g, _ = flatten_params(g)
+                flats.append(g)
+            stacked = jnp.stack(flats)
+        else:
+            if not hasattr(grads, "ndim") or grads.ndim != 2:
+                raise ValueError(
+                    "put_gradients wants N per-shard contributions (a "
+                    "sequence of trees/vectors or an (N, S) stack); a "
+                    "single replicated gradient/tree would be summed N "
+                    "times")
+            stacked = jnp.asarray(grads)
+        pad = (-stacked.shape[1]) % self.partition_num
+        if pad:
+            stacked = jnp.concatenate(
+                [stacked, jnp.zeros((stacked.shape[0], pad), stacked.dtype)],
+                axis=1)
+        return C.reduce_scatter(stacked, self.axis, self.mesh, mean=mean,
                                 wire_dtype=self.wire_dtype)
 
     def get_weights(self, sharded_flat):

@@ -1,0 +1,371 @@
+"""Caffe model import — pure-Python prototxt + caffemodel readers.
+
+Reference parity: utils/CaffeLoader.scala:38-162 — parse the prototxt
+(protobuf text format) and the binary caffemodel (protobuf wire format,
+fields per the generated caffe protobuf in the reference's
+dl/src/main/java/caffe/Caffe.java), then copy each layer's blobs into the
+model's ``get_parameters_table()`` entries by LAYER NAME: blob 0 → weight,
+blob 1 → bias, matched by element count and reshaped to the target
+parameter's shape (the reference copies into the flat Torch storage the
+same way). ``match_all`` raises when a parameterized module has no
+same-named caffe layer.
+
+No protobuf runtime is needed: the wire format is five primitive field
+encodings, and the loader touches only four message types (NetParameter,
+LayerParameter / V1LayerParameter, BlobProto, BlobShape).
+
+Layout compatibility notes (why a flat copy is correct):
+- Caffe convolution blobs are (out, in/group, kH, kW) — exactly this
+  repo's SpatialConvolution weight layout (nn/conv.py).
+- Caffe InnerProduct blobs are (out, in) — exactly Linear's (y = x W^T).
+- BatchNorm/Scale layers differ structurally from Torch BN; import those
+  by name into SpatialBatchNormalization's weight/bias the same way.
+"""
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Iterator
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.utils.caffe")
+
+__all__ = ["CaffeLoader", "load_caffe", "parse_caffemodel", "parse_prototxt"]
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format primitives
+# ---------------------------------------------------------------------------
+
+def _varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, bytes | int]]:
+    """Yield (field_number, wire_type, payload). Length-delimited payloads
+    come back as bytes; varints as int; fixed32/fixed64 as raw bytes."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:                       # varint
+            val, pos = _varint(buf, pos)
+            yield fnum, wtype, val
+        elif wtype == 1:                     # 64-bit
+            yield fnum, wtype, buf[pos:pos + 8]
+            pos += 8
+        elif wtype == 2:                     # length-delimited
+            ln, pos = _varint(buf, pos)
+            yield fnum, wtype, buf[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:                     # 32-bit
+            yield fnum, wtype, buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype} "
+                             f"(field {fnum} at byte {pos})")
+
+
+def _packed_or_single_f32(out: list, wtype, payload):
+    if wtype == 2:       # packed
+        out.append(np.frombuffer(payload, "<f4"))
+    else:                # unpacked single
+        out.append(np.frombuffer(payload, "<f4"))
+
+
+# ---------------------------------------------------------------------------
+# message readers (field numbers from the reference's generated Caffe.java)
+# ---------------------------------------------------------------------------
+
+class Blob:
+    """BlobProto: shape=7 (BlobShape.dim=1), data=5 (packed float),
+    double_data=8; legacy dims num=1 channels=2 height=3 width=4."""
+
+    __slots__ = ("shape", "data")
+
+    def __init__(self, shape: tuple[int, ...], data: np.ndarray):
+        self.shape = shape
+        self.data = data
+
+    @classmethod
+    def parse(cls, buf: bytes) -> "Blob":
+        data_parts: list[np.ndarray] = []
+        legacy = {}
+        shape: tuple[int, ...] | None = None
+        for fnum, wtype, payload in _fields(buf):
+            if fnum == 5:        # float data
+                _packed_or_single_f32(data_parts, wtype, payload)
+            elif fnum == 8:      # double data
+                data_parts.append(
+                    np.frombuffer(payload, "<f8").astype(np.float32))
+            elif fnum == 7:      # BlobShape
+                dims = []
+                pos = 0
+                for f2, w2, p2 in _fields(payload):
+                    if f2 == 1:
+                        if w2 == 2:   # packed varints
+                            pos = 0
+                            while pos < len(p2):
+                                d, pos = _varint(p2, pos)
+                                dims.append(d)
+                        else:
+                            dims.append(p2)
+                shape = tuple(dims)
+            elif fnum in (1, 2, 3, 4) and wtype == 0:
+                legacy[fnum] = payload
+        if shape is None and legacy:
+            shape = tuple(legacy.get(k, 1) for k in (1, 2, 3, 4))
+        data = (np.concatenate(data_parts) if data_parts
+                else np.zeros(0, np.float32))
+        return cls(shape or (data.size,), data)
+
+
+# V1LayerParameter enum type values -> canonical caffe type strings (only
+# the types the zoo needs; others render as "V1:<n>")
+_V1_TYPES = {
+    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout",
+    14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU",
+    19: "Sigmoid", 20: "Softmax", 21: "SoftmaxWithLoss", 22: "Split",
+    23: "TanH", 25: "Eltwise", 33: "Slice",
+}
+
+
+class Layer:
+    __slots__ = ("name", "type", "blobs")
+
+    def __init__(self, name: str, type_: str, blobs: list[Blob]):
+        self.name = name
+        self.type = type_
+        self.blobs = blobs
+
+    @classmethod
+    def parse_v2(cls, buf: bytes) -> "Layer":
+        """LayerParameter: name=1, type=2, blobs=7."""
+        name = type_ = ""
+        blobs = []
+        for fnum, wtype, payload in _fields(buf):
+            if fnum == 1:
+                name = payload.decode("utf-8", "replace")
+            elif fnum == 2:
+                type_ = payload.decode("utf-8", "replace")
+            elif fnum == 7:
+                blobs.append(Blob.parse(payload))
+        return cls(name, type_, blobs)
+
+    @classmethod
+    def parse_v1(cls, buf: bytes) -> "Layer":
+        """V1LayerParameter: name=4, type=5 (enum), blobs=6."""
+        name, type_ = "", ""
+        blobs = []
+        for fnum, wtype, payload in _fields(buf):
+            if fnum == 4:
+                name = payload.decode("utf-8", "replace")
+            elif fnum == 5 and wtype == 0:
+                type_ = _V1_TYPES.get(payload, f"V1:{payload}")
+            elif fnum == 6:
+                blobs.append(Blob.parse(payload))
+        return cls(name, type_, blobs)
+
+
+def parse_caffemodel(path: str) -> dict[str, Layer]:
+    """Read a binary caffemodel (NetParameter: layers(V1)=2, layer=100)
+    into name -> Layer. V2 entries win over V1 on name collision, matching
+    the reference's map-build order (CaffeLoader.scala:49-60)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    v1, v2 = {}, {}
+    for fnum, wtype, payload in _fields(buf):
+        if fnum == 2 and wtype == 2:
+            layer = Layer.parse_v1(payload)
+            v1[layer.name] = layer
+        elif fnum == 100 and wtype == 2:
+            layer = Layer.parse_v2(payload)
+            v2[layer.name] = layer
+    out = dict(v1)
+    out.update(v2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format) — minimal recursive parser
+# ---------------------------------------------------------------------------
+
+def _tokenize(text: str) -> list[str]:
+    tokens = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in " \t\r\n":
+            i += 1
+        elif c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in "{}:":
+            tokens.append(c)
+            i += 1
+        elif c in "\"'":
+            j = i + 1
+            while j < n and text[j] != c:
+                j += 1 + (text[j] == "\\")
+            tokens.append(text[i:j + 1])
+            i = j + 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n{}:#":
+                j += 1
+            tokens.append(text[i:j])
+            i = j
+    return tokens
+
+
+def _parse_value(tok: str):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok     # enum identifier
+
+
+def _parse_block(tokens: list[str], pos: int) -> tuple[dict, int]:
+    """Parse `key: value` / `key { ... }` pairs until '}' or EOF. Repeated
+    keys accumulate into lists."""
+    out: dict = {}
+
+    def put(k, v):
+        if k in out:
+            if not isinstance(out[k], list):
+                out[k] = [out[k]]
+            out[k].append(v)
+        else:
+            out[k] = v
+
+    while pos < len(tokens) and tokens[pos] != "}":
+        key = tokens[pos]
+        pos += 1
+        if pos < len(tokens) and tokens[pos] == ":":
+            pos += 1
+            if tokens[pos] == "{":      # message after colon (legal)
+                sub, pos = _parse_block(tokens, pos + 1)
+                pos += 1                # consume '}'
+                put(key, sub)
+            else:
+                put(key, _parse_value(tokens[pos]))
+                pos += 1
+        elif pos < len(tokens) and tokens[pos] == "{":
+            sub, pos = _parse_block(tokens, pos + 1)
+            pos += 1
+            put(key, sub)
+        else:
+            raise ValueError(f"prototxt parse error near token {pos}: "
+                             f"{tokens[max(0, pos - 3):pos + 3]}")
+    return out, pos
+
+
+def parse_prototxt(path: str) -> dict:
+    """Parse a .prototxt into nested dicts (repeated keys -> lists);
+    net['layer'] / net['layers'] hold the layer definitions."""
+    with open(path, "r", encoding="ascii", errors="replace") as f:
+        tokens = _tokenize(f.read())
+    net, _ = _parse_block(tokens, 0)
+    return net
+
+
+def _aslist(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# the loader
+# ---------------------------------------------------------------------------
+
+class CaffeLoader:
+    """Copy caffe parameters into a bigdl_tpu model by layer name
+    (reference CaffeLoader.scala:38-162)."""
+
+    def __init__(self, prototxt_path: str, model_path: str,
+                 match_all: bool = True):
+        self.prototxt_path = prototxt_path
+        self.model_path = model_path
+        self.match_all = match_all
+        self._layers: dict[str, Layer] | None = None
+        self._net_def: dict | None = None
+
+    def _load(self):
+        if self._layers is None:
+            self._net_def = parse_prototxt(self.prototxt_path)
+            logger.info("start loading caffe model from %s", self.model_path)
+            self._layers = parse_caffemodel(self.model_path)
+            logger.info("load caffe model done (%d layers with blobs: %s)",
+                        len(self._layers),
+                        [n for n, l in self._layers.items() if l.blobs])
+
+    def _get_blob(self, name: str, ind: int) -> Blob | None:
+        layer = self._layers.get(name)
+        if layer is not None and len(layer.blobs) > ind:
+            return layer.blobs[ind]
+        return None
+
+    def _copy_one(self, name: str, params: dict, key: str, ind: int):
+        blob = self._get_blob(name, ind)
+        if blob is None:
+            return
+        if key not in params:
+            raise ValueError(f"{name} should contain {key}")
+        target = params[key]
+        if int(np.prod(target.shape)) != blob.data.size:
+            raise ValueError(
+                f"{key} element number is not equal between caffe layer and "
+                f"bigdl module {name}, data shape in caffe is {blob.shape}, "
+                f"while data shape in bigdl is {target.shape}")
+        import jax.numpy as jnp
+        params[key] = jnp.asarray(
+            blob.data.reshape(target.shape), dtype=target.dtype)
+
+    def copy_parameters(self, model):
+        """(reference copyParameters, :132-151) — mutates the model's
+        parameter table in place and returns the model."""
+        self._load()
+        if hasattr(model, "materialize"):
+            model.materialize()
+        table = model.get_parameters_table()
+        for name, params in table.items():
+            if not isinstance(params, dict) or \
+                    ("weight" not in params and "bias" not in params):
+                continue
+            if name not in self._layers:
+                if self.match_all:
+                    raise ValueError(
+                        f"module {name} cannot map a layer in caffe model")
+                logger.info("%s uses initialized parameters", name)
+                continue
+            logger.info("load parameters for %s ...", name)
+            self._copy_one(name, params, "weight", 0)
+            self._copy_one(name, params, "bias", 1)
+        # re-sync facades: container params reference the mutated child
+        # dicts, so rebinding the root is enough to refresh views
+        model.sync(model.params, model.state)
+        return model
+
+
+def load_caffe(model, def_path: str, model_path: str,
+               match_all: bool = True):
+    """(reference Module.loadCaffe / object CaffeLoader.load)"""
+    return CaffeLoader(def_path, model_path, match_all).copy_parameters(model)

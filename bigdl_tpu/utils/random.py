@@ -37,8 +37,25 @@ class RandomGenerator:
     @classmethod
     def set_seed(cls, seed: int) -> "RandomGenerator":
         cls._default_seed = seed
+        return cls.seed_thread(seed)
+
+    @classmethod
+    def seed_thread(cls, seed: int) -> "RandomGenerator":
+        """Seed ONLY the calling thread's generator (the class default
+        stays untouched)."""
         cls._local.inst = cls(seed)
         return cls._local.inst
+
+    @classmethod
+    def seed_worker(cls, worker_index: int, invocation: int = 0
+                    ) -> "RandomGenerator":
+        """Seed a worker thread's generator with a stream distinct per
+        worker AND per pipeline invocation: workers must not duplicate
+        each other's crops/flips, and epoch N must not replay epoch 1's
+        augmentation (pipelines are re-created per epoch)."""
+        return cls.seed_thread(cls._default_seed
+                               + 0x9E3779B1 * (worker_index + 1)
+                               + 0x85EBCA77 * invocation)
 
     # -- draws (reference RandomGenerator.scala:49-265) --
     def uniform(self, a: float = 0.0, b: float = 1.0, size=None):

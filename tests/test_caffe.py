@@ -1,0 +1,168 @@
+"""Caffe import tests (mirrors reference CaffeLoaderSpec.scala).
+
+The binary fixture under tests/resources/caffe was produced by real Caffe
+(via the reference's test resources) — loading it validates wire-format
+compatibility; the golden values are the ones CaffeLoaderSpec pins.
+"""
+import struct
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.caffe import (CaffeLoader, load_caffe, parse_caffemodel,
+                                   parse_prototxt)
+
+RES = Path(__file__).parent / "resources" / "caffe"
+
+
+def fixture_model():
+    """Model matching test.prototxt (CaffeLoaderSpec.scala builds the same
+    stack: conv(3->4,k2) -> conv2(4->3,k2) -> ip(27->2, no bias))."""
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 4, 2, 2).set_name("conv"))
+            .add(nn.SpatialConvolution(4, 3, 2, 2).set_name("conv2"))
+            .add(nn.View(27))
+            .add(nn.Linear(27, 2, with_bias=False).set_name("ip")))
+
+
+class TestWireParser:
+    def _varint_bytes(self, v):
+        out = b""
+        while True:
+            b7 = v & 0x7F
+            v >>= 7
+            out += bytes([b7 | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    def _ld(self, fnum, payload):
+        return self._varint_bytes((fnum << 3) | 2) + \
+            self._varint_bytes(len(payload)) + payload
+
+    def test_synthetic_v2_net(self):
+        data = np.arange(6, dtype=np.float32)
+        blob = (self._ld(7, self._ld(1, self._varint_bytes(2) +
+                                     self._varint_bytes(3))) +
+                self._ld(5, data.tobytes()))
+        layer = (self._ld(1, b"fc") + self._ld(2, b"InnerProduct") +
+                 self._ld(7, blob))
+        net = self._ld(100, layer)
+        layers = _write_and_parse(net)
+        assert set(layers) == {"fc"}
+        assert layers["fc"].type == "InnerProduct"
+        assert layers["fc"].blobs[0].shape == (2, 3)
+        np.testing.assert_array_equal(layers["fc"].blobs[0].data, data)
+
+    def test_synthetic_v1_net_legacy_dims_unpacked_floats(self):
+        # V1LayerParameter name=4, type=5 (enum 14 = InnerProduct), blobs=6;
+        # legacy blob dims num/channels/height/width + unpacked floats
+        floats = b"".join(
+            self._varint_bytes((5 << 3) | 5) + struct.pack("<f", v)
+            for v in [1.5, -2.5])
+        blob = (self._varint_bytes((1 << 3) | 0) + self._varint_bytes(1) +
+                self._varint_bytes((2 << 3) | 0) + self._varint_bytes(2) +
+                self._varint_bytes((3 << 3) | 0) + self._varint_bytes(1) +
+                self._varint_bytes((4 << 3) | 0) + self._varint_bytes(1) +
+                floats)
+        layer = (self._ld(4, b"old") +
+                 self._varint_bytes((5 << 3) | 0) + self._varint_bytes(14) +
+                 self._ld(6, blob))
+        net = self._ld(2, layer)
+        layers = _write_and_parse(net)
+        assert layers["old"].type == "InnerProduct"
+        assert layers["old"].blobs[0].shape == (1, 2, 1, 1)
+        np.testing.assert_allclose(layers["old"].blobs[0].data, [1.5, -2.5])
+
+
+def _write_and_parse(net_bytes):
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".caffemodel",
+                                     delete=False) as f:
+        f.write(net_bytes)
+        path = f.name
+    return parse_caffemodel(path)
+
+
+@pytest.mark.skipif(not (RES / "test.caffemodel").exists(),
+                    reason="caffe fixture missing")
+class TestFixtureImport:
+    def test_prototxt_parse(self):
+        net = parse_prototxt(str(RES / "test.prototxt"))
+        assert net["name"] == "convolution"
+        names = [l["name"] for l in net["layer"]]
+        assert names == ["conv", "conv2", "ip"]
+        assert net["layer"][0]["type"] == "Convolution"
+        assert net["layer"][0]["convolution_param"]["num_output"] == 4
+        assert net["input_dim"] == [1, 3, 5, 5]
+
+    def test_match_all_golden_values(self):
+        """Golden values from reference CaffeLoaderSpec.scala."""
+        model = fixture_model()
+        load_caffe(model, str(RES / "test.prototxt"),
+                   str(RES / "test.caffemodel"))
+        t = model.get_parameters_table()
+        conv_w = np.asarray(t["conv"]["weight"]).reshape(-1)
+        np.testing.assert_allclose(
+            conv_w[:8],
+            [0.4156779647, 0.3547672033, 0.1817495823, -0.1393318474,
+             0.4004031420, 0.0634599924, 0.1571258903, 0.4180541039],
+            atol=1e-6)
+        assert t["conv"]["weight"].shape == (4, 3, 2, 2)
+        np.testing.assert_allclose(
+            np.asarray(t["conv"]["bias"]),
+            [0.0458712392, -0.0029324144, -0.0251041390, 0.0052924110],
+            atol=1e-6)
+        conv2_w = np.asarray(t["conv2"]["weight"]).reshape(-1)
+        np.testing.assert_allclose(
+            conv2_w[:4],
+            [0.0154178329, 0.0157190431, 0.0033829932, -0.0048461366],
+            atol=1e-6)
+        np.testing.assert_allclose(np.asarray(t["conv2"]["bias"]),
+                                   [0.0, 0.0, 0.0], atol=1e-6)
+        ip_w = np.asarray(t["ip"]["weight"]).reshape(-1)
+        np.testing.assert_allclose(
+            ip_w[:4],
+            [0.0189033747, 0.0401176214, 0.0525088012, 0.3013394773],
+            atol=1e-6)
+        assert t["ip"]["weight"].shape == (2, 27)
+        assert "bias" not in t["ip"]
+
+    def test_loaded_params_reach_container_tree(self):
+        """The import must update the tree the training/inference paths
+        read (container params reference the mutated child dicts)."""
+        model = fixture_model()
+        load_caffe(model, str(RES / "test.prototxt"),
+                   str(RES / "test.caffemodel"))
+        root_w = np.asarray(model.params["0"]["weight"]).reshape(-1)
+        assert abs(root_w[0] - 0.4156779647) < 1e-6
+        x = np.zeros((1, 3, 5, 5), np.float32)
+        y = model.forward(x)          # forward consumes imported weights
+        assert y.shape == (1, 2)
+
+    def test_match_part(self):
+        """matchAll=False skips unmatched modules (spec case 2); True
+        raises."""
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 4, 2, 2).set_name("conv"))
+                 .add(nn.SpatialConvolution(4, 3, 2, 2).set_name("conv3"))
+                 .add(nn.View(27))
+                 .add(nn.Linear(27, 2, with_bias=False).set_name("ip")))
+        with pytest.raises(ValueError, match="cannot map"):
+            load_caffe(model.clone_module(), str(RES / "test.prototxt"),
+                       str(RES / "test.caffemodel"))
+        loaded = load_caffe(model, str(RES / "test.prototxt"),
+                            str(RES / "test.caffemodel"), match_all=False)
+        t = loaded.get_parameters_table()
+        w = np.asarray(t["conv"]["weight"]).reshape(-1)
+        assert abs(w[0] - 0.4156779647) < 1e-6
+        ip = np.asarray(t["ip"]["weight"]).reshape(-1)
+        assert abs(ip[0] - 0.0189033747) < 1e-6
+
+    def test_element_count_mismatch_raises(self):
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 8, 2, 2).set_name("conv")))
+        with pytest.raises(ValueError, match="element number"):
+            load_caffe(model, str(RES / "test.prototxt"),
+                       str(RES / "test.caffemodel"), match_all=False)

@@ -104,6 +104,50 @@ class TestImageTransforms:
         np.testing.assert_allclose(img.content[..., 2], 1.0)  # R at BGR idx 2
         assert img.label == 3.0
 
+    def test_transforms_do_not_mutate_source_across_epochs(self):
+        """Regression: transformers must not rebind content on the cached
+        source objects — a multi-epoch training iterator re-reads the same
+        LabeledImages, so in-place pipelines would compound transforms
+        every pass (normalize twice, crop-of-crop, ...)."""
+        from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+        imgs = bgr_images(n=6, h=10, w=10)
+        originals = [i.content.copy() for i in imgs]
+        ds = LocalArrayDataSet(imgs)
+        pipe = (BGRImgCropper(8, 8, CropCenter)
+                >> HFlip(1.0)
+                >> BGRImgNormalizer(0.25, 0.25, 0.25, 0.5, 0.5, 0.5)
+                >> Lighting())
+        RandomGenerator.set_seed(11)
+        pass1 = [o.content.copy() for o in pipe(ds.data(train=False))]
+        RandomGenerator.set_seed(11)
+        pass2 = [o.content.copy() for o in pipe(ds.data(train=False))]
+        for a, b in zip(pass1, pass2):
+            np.testing.assert_array_equal(a, b)
+        for img, orig in zip(imgs, originals):
+            np.testing.assert_array_equal(img.content, orig)
+
+    def test_mt_batch_claim_order_and_single_tail(self):
+        """Batches come out in claim order (labels stay sequential) and at
+        most ONE short tail batch is emitted."""
+        imgs = bgr_images(n=22)          # 5 full batches of 4 + tail of 2
+        inner = BGRImgNormalizer(0.0, 0.0, 0.0, 1.0, 1.0, 1.0)
+        out = list(MTImgToBatch(4, inner, num_threads=3)(iter(imgs)))
+        sizes = [b.data.shape[0] for b in out]
+        assert sizes == [4, 4, 4, 4, 4, 2]
+        labels = np.concatenate([b.labels for b in out])
+        np.testing.assert_array_equal(labels, np.arange(1, 23, dtype=np.float32))
+
+    def test_mt_batch_workers_draw_distinct_random_streams(self):
+        """Random augmentation must differ across worker threads (shared
+        default seeds would duplicate crops/flips across workers)."""
+        imgs = [LabeledBGRImage(np.arange(300, dtype=np.float32)
+                                .reshape(10, 10, 3), float(i + 1))
+                for i in range(8)]
+        inner = BGRImgCropper(4, 4)       # random crop
+        out = list(MTImgToBatch(1, inner, num_threads=4)(iter(imgs)))
+        flat = {tuple(b.data.reshape(-1)[:8]) for b in out}
+        assert len(flat) > 1
+
     def test_mt_batch_matches_serial(self):
         imgs = bgr_images(n=20)
         inner = BGRImgNormalizer(0.5, 0.5, 0.5, 1.0, 1.0, 1.0)

@@ -1,0 +1,43 @@
+"""Example-app tests (reference example/ — SURVEY §2.10)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def _make_val_tree(root, n_per_class=3, size=260):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in ("daisy", "rose"):
+        d = root / "val" / cls
+        d.mkdir(parents=True)
+        for i in range(n_per_class):
+            arr = rng.integers(0, 256, (size, size, 3), np.uint8)
+            Image.fromarray(arr).save(d / f"img{i}.png")
+
+
+class TestModelValidator:
+    def test_bigdl_model_end_to_end(self, tmp_path):
+        """CLI path: save a bigdl snapshot, validate it over an image-folder
+        val tree (reference ModelValidator bigdl branch)."""
+        from bigdl_tpu.examples.loadmodel import model_validator
+        _make_val_tree(tmp_path)
+        model = (nn.Sequential()
+                 .add(nn.SpatialAveragePooling(224, 224, 224, 224))
+                 .add(nn.View(3))
+                 .add(nn.Linear(3, 2))
+                 .add(nn.LogSoftMax()))
+        model.materialize()
+        mpath = tmp_path / "model.bigdl"
+        model.save(str(mpath))
+        results = model_validator.main([
+            "-f", str(tmp_path), "-m", "resnet", "-t", "bigdl",
+            "--modelPath", str(mpath), "-b", "2"])
+        assert len(results) == 2
+        top1 = results[0][0].result()[0]
+        assert 0.0 <= top1 <= 1.0
+
+    def test_unknown_type_raises(self):
+        from bigdl_tpu.examples.loadmodel import model_validator
+        with pytest.raises(ValueError, match="torch, caffe or bigdl"):
+            model_validator.main(["-m", "resnet", "-t", "mxnet"])
