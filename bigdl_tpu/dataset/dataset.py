@@ -49,6 +49,16 @@ class AbstractDataSet:
         data-parallel ShardedDataSet — drives Optimizer factory dispatch."""
         return False
 
+    def get_position_state(self):
+        """Checkpointable pipeline position (shuffle permutation etc.);
+        None when the source has no such state. Paired with
+        ``set_position_state`` so a resumed run replays the exact data
+        order of the stopped run."""
+        return None
+
+    def set_position_state(self, state, mid_pass: bool = False) -> None:
+        pass
+
     def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
         return self.transform(transformer)
 
@@ -69,6 +79,12 @@ class TransformedDataSet(AbstractDataSet):
 
     def is_sharded(self):
         return self.base.is_sharded()
+
+    def get_position_state(self):
+        return self.base.get_position_state()
+
+    def set_position_state(self, state, mid_pass: bool = False):
+        self.base.set_position_state(state, mid_pass)
 
     def local_size(self):
         base_local = getattr(self.base, "local_size", self.base.size)
@@ -102,6 +118,12 @@ class LocalArrayDataSet(AbstractDataSet):
         """(reference shuffle: re-randomize the index array)"""
         RandomGenerator.RNG().shuffle(self._index)
 
+    def get_position_state(self):
+        return {"index": self._index.copy()}
+
+    def set_position_state(self, state, mid_pass: bool = False):
+        self._index = np.asarray(state["index"]).copy()
+
 
 class ShardedDataSet(AbstractDataSet):
     """Data-parallel sharded dataset (replaces the reference's
@@ -119,9 +141,21 @@ class ShardedDataSet(AbstractDataSet):
         self.shard_index = shard_index
         self._local = self._all[shard_index::num_shards]
         self._index = np.arange(len(self._local))
+        self._pass_count = 0
 
     def is_sharded(self):
         return True
+
+    def _pass_offset(self, k: int) -> int:
+        """Per-pass start offset, a pure function of (seed, shard, pass) —
+        NOT a draw from the shared host RNG stream, so a resumed run can
+        replay the exact pass the stopped run was in."""
+        if len(self._index) == 0:
+            return 0
+        mix = (RandomGenerator._default_seed * 2654435761
+               + self.shard_index * 40503 + k) % (2 ** 32)
+        g = np.random.Generator(np.random.MT19937(mix))
+        return int(g.integers(0, len(self._index)))
 
     def data(self, train: bool):
         if train:
@@ -130,9 +164,10 @@ class ShardedDataSet(AbstractDataSet):
                     f"shard {self.shard_index}/{self.num_shards} is empty — "
                     "fewer samples than shards")
             def endless():
-                rng = RandomGenerator.RNG()
                 while True:
-                    offset = int(rng.random_int(0, max(len(self._index), 1)))
+                    k = self._pass_count
+                    self._pass_count = k + 1
+                    offset = self._pass_offset(k)
                     order = np.roll(self._index, -offset)
                     for i in order:
                         yield self._local[i]
@@ -148,6 +183,18 @@ class ShardedDataSet(AbstractDataSet):
 
     def shuffle(self):
         RandomGenerator.RNG().shuffle(self._index)
+
+    def get_position_state(self):
+        return {"index": self._index.copy(),
+                "passes_started": self._pass_count}
+
+    def set_position_state(self, state, mid_pass: bool = False):
+        self._index = np.asarray(state["index"]).copy()
+        passes = int(np.asarray(state.get("passes_started", 0)))
+        # mid_pass: the stopped run was inside pass k = passes-1; the fresh
+        # training iterator must replay that same pass (the optimizer then
+        # fast-forwards past the consumed batches)
+        self._pass_count = passes - 1 if (mid_pass and passes > 0) else passes
 
 
 class _BatchIterable(AbstractDataSet):

@@ -77,20 +77,17 @@ class DistriOptimizer(Optimizer):
         model.materialize()
         model.training()
         params, mstate = model.params, model.state
-        opt_state = optim.init_state(params)
+        driver_state = {"epoch": int(self.state.get("epoch", 1)),
+                        "neval": int(self.state.get("neval", 1)),
+                        "is_epoch_end": False, "loss": float("inf")}
+        opt_state, rng, count_this_epoch, batches_to_skip = \
+            self._resume(optim, params)
 
         repl = replicated(mesh)
         batch_shard = data_sharding(mesh)
         params = jax.device_put(params, repl)
         mstate = jax.device_put(mstate, repl)
         opt_state = jax.device_put(opt_state, repl)
-
-        driver_state = {"epoch": int(self.state.get("epoch", 1)),
-                        "neval": int(self.state.get("neval", 1)),
-                        "is_epoch_end": False, "loss": float("inf")}
-        if driver_state["neval"] > 1:
-            opt_state["neval"] = jax.device_put(
-                jnp.asarray(driver_state["neval"] - 1, jnp.int32), repl)
 
         def train_step(params, mstate, opt_state, rng, data, labels, epoch):
             def loss_fn(p):
@@ -136,14 +133,16 @@ class DistriOptimizer(Optimizer):
             out = jit_eval(p, s, jax.device_put(d, batch_shard))
             return np.asarray(out)[:n]
 
-        rng = jax.random.PRNGKey(int(self.state.get("seed", 0)))
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
-        count_this_epoch = int(self.state.get("record_count", 0))
+        batches_this_epoch = batches_to_skip
+        for _ in range(batches_to_skip):   # fast-forward to the stop point
+            next(data_iter)
         wallclock_start = time.perf_counter()
 
         while self.end_when is None or not self.end_when(driver_state):
             driver_state["is_epoch_end"] = False
+            self._profile_hook(driver_state["neval"])
             t0 = time.perf_counter()
             batch = next(data_iter)
             data, labels = np.asarray(batch.data), np.asarray(batch.labels)
@@ -154,37 +153,55 @@ class DistriOptimizer(Optimizer):
                     f"{n_shards} mesh devices (reference Utils.getBatchSize "
                     "divisibility requirement, dataset/Utils.scala:25-47)")
             data, labels = self._shard_batch(data, labels, batch_shard)
-            data_time = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
             params, mstate, opt_state, loss = jit_step(
                 params, mstate, opt_state, step_rng, data, labels,
                 jnp.asarray(driver_state["epoch"], jnp.int32))
             loss = float(loss)
-            step_time = time.perf_counter() - t0
+            t2 = time.perf_counter()
+            device_time = t2 - t1
+            step_time = t2 - t0
             n = global_n  # records consumed across all hosts this step
             count_this_epoch += n
+            batches_this_epoch += 1
             driver_state["loss"] = loss
             wallclock = time.perf_counter() - wallclock_start
             logger.info(
                 self._header(driver_state["epoch"], count_this_epoch,
                              epoch_size, driver_state["neval"], wallclock)
                 + f" loss is {loss:.6f}, iteration time is {step_time:.4f}s,"
-                f" data load+shard time is {data_time:.4f}s, throughput is "
+                f" host input time is {data_time:.4f}s, device step time is "
+                f"{device_time:.4f}s, throughput is "
                 f"{n / max(step_time, 1e-9):.2f} records/second")
-            # phase metrics (reference DistriOptimizer.scala:113-117 names)
-            self.metrics.add("computing time for each node", step_time)
-            self.metrics.add("get weights average", data_time)
+            # honest phase metrics: the reference's get-weights/compute/
+            # aggregate phases fuse inside the jitted step, so what's
+            # measurable is host input vs device step (see metrics.py)
+            self.metrics.record("device step time", device_time)
+            self.metrics.record("host input time", data_time)
+            if logger.isEnabledFor(logging.DEBUG):
+                logger.debug(self.metrics.summary())
             driver_state["neval"] += 1
             if count_this_epoch >= epoch_size:
                 driver_state["epoch"] += 1
                 driver_state["is_epoch_end"] = True
                 count_this_epoch = 0
+                batches_this_epoch = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
-            model.sync(params, mstate)
-            self._validate(eval_fn, params, mstate, driver_state)
-            self._checkpoint(driver_state)
+            fire_val, fire_ckpt = self._fires(driver_state)
+            if fire_val or fire_ckpt:
+                # publish params only when validation/checkpoint will read
+                # them (host-side tree walk is overhead on deep models)
+                model.sync(params, mstate)
+            self._validate(eval_fn, params, mstate, driver_state,
+                           fire=fire_val)
+            self._checkpoint(driver_state, opt_state, rng,
+                             count_this_epoch, batches_this_epoch,
+                             fire=fire_ckpt)
 
+        self._stop_profiler()
         model.sync(params, mstate)
         model.evaluate()
         return model

@@ -3,23 +3,38 @@
 Reference parity: optim/Metrics.scala:24-117 — named counters in local /
 aggregate / per-node-distributed scopes, dumped via ``summary()``. The Spark
 accumulator scopes collapse to host-side counters here (one process per
-host in the TPU runtime); per-phase timings are set each iteration by the
-optimizers, mirroring DistriOptimizer.scala:113-117.
+host in the TPU runtime).
+
+Honest phase naming: the reference's per-iteration phases ("get weights
+average", "computing time for each node", "aggregate gradient time") don't
+exist under XLA — weight sync, compute, and the gradient allreduce fuse
+into one compiled step. The optimizers therefore record what IS measurable:
+
+- ``host input time``  — next(batch) + host->device sharding
+- ``device step time`` — dispatch + execution of the jitted train step
+
+``record()`` keeps the per-iteration series so ``stats()``/``summary()``
+report the distribution (mean/p50/p95/max) — the SPMD replacement for the
+reference's straggler diagnostics (per-replica time table,
+DistriOptimizer.scala:249-277): lockstep collectives can't drop members,
+but a fat tail in step time is still the signal an operator looks for.
 """
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 
 __all__ = ["Metrics"]
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, keep: int = 4096):
         self._lock = threading.Lock()
         self._scalars: dict[str, float] = {}
         self._counts: dict[str, int] = defaultdict(int)
         self._distributed: dict[str, list] = {}
+        self._series: dict[str, deque] = {}
+        self._keep = keep
 
     def set(self, name: str, value: float, parallel: int = 1):
         """(reference Metrics.set)"""
@@ -39,9 +54,31 @@ class Metrics:
     def get(self, name: str) -> float:
         return self._scalars.get(name, 0.0)
 
-    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
-        """(reference Metrics.summary, Metrics.scala:96-108)"""
+    def record(self, name: str, value: float):
+        """Append to the per-iteration series for ``name`` (bounded to the
+        last ``keep`` observations)."""
         with self._lock:
+            if name not in self._series:
+                self._series[name] = deque(maxlen=self._keep)
+            self._series[name].append(float(value))
+
+    def stats(self, name: str) -> dict:
+        """Distribution of a recorded series: n/mean/p50/p95/max."""
+        import numpy as np
+        with self._lock:
+            vals = np.asarray(self._series.get(name, ()), dtype=float)
+        if vals.size == 0:
+            return {"n": 0, "mean": 0.0, "p50": 0.0, "p95": 0.0, "max": 0.0}
+        return {"n": int(vals.size), "mean": float(vals.mean()),
+                "p50": float(np.percentile(vals, 50)),
+                "p95": float(np.percentile(vals, 95)),
+                "max": float(vals.max())}
+
+    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
+        """(reference Metrics.summary, Metrics.scala:96-108) — scalar means
+        plus distribution lines for recorded series."""
+        with self._lock:
+            series_names = sorted(self._series)
             lines = ["========== Metrics Summary =========="]
             for k in sorted(self._scalars):
                 # add()-accumulated metrics report their mean, matching the
@@ -51,5 +88,11 @@ class Metrics:
                 lines.append(f"{k} : {self._scalars[k] / denom} {unit}")
             for k in sorted(self._distributed):
                 lines.append(f"{k} : {self._distributed[k]}")
-            lines.append("=====================================")
-            return "\n".join(lines)
+        for k in series_names:
+            s = self.stats(k)
+            lines.append(
+                f"{k} : mean={s['mean']:.6f}{unit} p50={s['p50']:.6f}{unit} "
+                f"p95={s['p95']:.6f}{unit} max={s['max']:.6f}{unit} "
+                f"(n={s['n']})")
+        lines.append("=====================================")
+        return "\n".join(lines)

@@ -18,6 +18,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.dataset.dataset import (AbstractDataSet, ShardedDataSet,
                                        to_jax_batch)
@@ -67,6 +68,10 @@ class Optimizer:
         self.checkpoint_path = None
         self.is_overwrite = False
         self.metrics = Metrics()
+        self.profile_dir = None
+        self.profile_start = 0
+        self.profile_iters = 0
+        self._profiling = False
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -105,11 +110,16 @@ class Optimizer:
         return f"[Epoch {epoch} {count}/{total}][Iteration {neval}]" \
                f"[Wall Clock {wallclock:.3f}s]"
 
-    def _validate(self, apply_fn, params, mstate, driver_state):
-        if self.validation_trigger is None or \
-                self.validation_dataset is None:
-            return None
-        if not self.validation_trigger(driver_state):
+    def _validate(self, apply_fn, params, mstate, driver_state, *,
+                  fire: bool | None = None):
+        """``fire``: pre-evaluated trigger decision from :meth:`_fires`;
+        None (direct callers/tests) evaluates the trigger here."""
+        if fire is None:
+            if self.validation_trigger is None or \
+                    self.validation_dataset is None:
+                return None
+            fire = self.validation_trigger(driver_state)
+        if not fire:
             return None
         results = [None] * len(self.validation_methods)
         count = 0
@@ -128,20 +138,122 @@ class Optimizer:
             logger.info(f"{m!r} is {r!r}")
         return dict(zip([repr(m) for m in self.validation_methods], results))
 
-    def _checkpoint(self, driver_state):
-        if self.checkpoint_trigger is None or self.checkpoint_path is None:
-            return
-        if not self.checkpoint_trigger(driver_state):
+    def _checkpoint(self, driver_state, opt_state=None, rng=None,
+                    record_count=0, batches_this_epoch=0, *,
+                    fire: bool | None = None):
+        """Save the WHOLE training state on trigger (reference
+        DistriOptimizer.scala:319-341 saves the full state Table): driver
+        counters + optimizer state (momentum/accumulators) + device rng +
+        data-pipeline position + host-rng state, so a resumed run is the
+        run that was stopped. ``fire``: pre-evaluated trigger decision."""
+        if fire is None:
+            if self.checkpoint_trigger is None or \
+                    self.checkpoint_path is None:
+                return
+            fire = self.checkpoint_trigger(driver_state)
+        if not fire:
             return
         from bigdl_tpu.utils import file as _file
+        from bigdl_tpu.utils.random import RandomGenerator
         neval = driver_state["neval"]
         suffix = "" if self.is_overwrite else f".{neval}"
         _file.save_module(self.model,
                           f"{self.checkpoint_path}/model{suffix}",
                           overwrite=True)
-        _file.save(dict(driver_state),
+        full_state = dict(driver_state)
+        full_state["record_count"] = record_count
+        full_state["batches_this_epoch"] = batches_this_epoch
+        if opt_state is not None:
+            full_state["opt_state"] = jax.tree.map(
+                lambda v: np.asarray(v), opt_state)
+        if rng is not None:
+            full_state["rng"] = np.asarray(rng)
+        import pickle
+        # opaque bytes: the nested state dict (strings/ints/arrays) must
+        # round-trip exactly, not through the array-flattening save path
+        full_state["host_rng_state"] = pickle.dumps(
+            RandomGenerator.RNG()._rng.bit_generator.state)
+        pos = self.dataset.get_position_state()
+        if pos is not None:
+            full_state["data_position"] = pos
+        _file.save(full_state,
                    f"{self.checkpoint_path}/state{suffix}", overwrite=True)
         logger.info(f"Save model to {self.checkpoint_path}/model{suffix}")
+
+    def set_profiler(self, trace_dir: str, start_iteration: int = 10,
+                     num_iterations: int = 5):
+        """Capture a ``jax.profiler`` trace of iterations
+        [start, start+num) into ``trace_dir`` (SURVEY §7 step 7 — the
+        XLA-native replacement for the reference's per-module
+        forwardTime/backwardTime inspection; open with TensorBoard or
+        Perfetto)."""
+        self.profile_dir = trace_dir
+        self.profile_start = start_iteration
+        self.profile_iters = num_iterations
+        return self
+
+    def _profile_hook(self, neval: int):
+        if self.profile_dir is None:
+            return
+        if not self._profiling and self.profile_iters > 0 and \
+                self.profile_start <= neval < self.profile_start + \
+                self.profile_iters:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and neval >= self.profile_start + \
+                self.profile_iters:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("profiler trace written to %s", self.profile_dir)
+
+    def _stop_profiler(self):
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
+
+    def _fires(self, driver_state) -> tuple[bool, bool]:
+        """Evaluate the validation and checkpoint triggers EXACTLY once per
+        iteration (a stateful user trigger must not be consumed twice) and
+        return (fire_validation, fire_checkpoint)."""
+        fire_val = (self.validation_trigger is not None
+                    and self.validation_dataset is not None
+                    and self.validation_trigger(driver_state))
+        fire_ckpt = (self.checkpoint_trigger is not None
+                     and self.checkpoint_path is not None
+                     and self.checkpoint_trigger(driver_state))
+        return fire_val, fire_ckpt
+
+    def _resume(self, optim, params):
+        """Rebuild (opt_state, rng, count_this_epoch, batches_to_skip) from
+        ``self.state`` — full-fidelity when the state came from a round-2
+        checkpoint, best-effort (the reference's epoch/neval semantics)
+        otherwise."""
+        from bigdl_tpu.utils.random import RandomGenerator
+        opt_state = optim.init_state(params)
+        saved = self.state.get("opt_state")
+        if saved is not None:
+            opt_state = jax.tree.map(jnp.asarray, dict(saved))
+        elif int(self.state.get("neval", 1)) > 1:
+            # legacy states carry no optimizer state — at least restore the
+            # LR-schedule counter so decay doesn't restart
+            opt_state["neval"] = jnp.asarray(
+                int(self.state["neval"]) - 1, jnp.int32)
+        saved_rng = self.state.get("rng")
+        rng = (jnp.asarray(saved_rng) if saved_rng is not None
+               else jax.random.PRNGKey(int(self.state.get("seed", 0))))
+        host_state = self.state.get("host_rng_state")
+        if host_state is not None:
+            import pickle
+            if not isinstance(host_state, bytes):
+                host_state = np.asarray(host_state).item()
+            RandomGenerator.RNG()._rng.bit_generator.state = \
+                pickle.loads(host_state)
+        count = int(self.state.get("record_count", 0))
+        skip = int(self.state.get("batches_this_epoch", 0))
+        pos = self.state.get("data_position")
+        if pos is not None:
+            self.dataset.set_position_state(pos, mid_pass=skip > 0)
+        return opt_state, rng, count, skip
 
 
 class LocalOptimizer(Optimizer):
@@ -153,15 +265,14 @@ class LocalOptimizer(Optimizer):
         model.materialize()
         model.training()
         params, mstate = model.params, model.state
-        opt_state = optim.init_state(params)
         # resume support (reference: epoch/neval live in the state Table,
-        # DistriOptimizer.scala:80-81)
+        # DistriOptimizer.scala:80-81; full opt_state/rng/data-position
+        # restore when the state came from a checkpoint)
         driver_state = {"epoch": int(self.state.get("epoch", 1)),
                         "neval": int(self.state.get("neval", 1)),
                         "is_epoch_end": False, "loss": float("inf")}
-        if driver_state["neval"] > 1:
-            opt_state["neval"] = jnp.asarray(driver_state["neval"] - 1,
-                                             jnp.int32)
+        opt_state, rng, count_this_epoch, batches_to_skip = \
+            self._resume(optim, params)
 
         def train_step(params, mstate, opt_state, rng, data, labels, epoch):
             def loss_fn(p):
@@ -184,49 +295,64 @@ class LocalOptimizer(Optimizer):
 
         jit_eval = jax.jit(eval_apply)
 
-        rng = jax.random.PRNGKey(int(self.state.get("seed", 0)))
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
-        count_this_epoch = int(self.state.get("record_count", 0))
+        batches_this_epoch = batches_to_skip
+        for _ in range(batches_to_skip):   # fast-forward to the stop point
+            next(data_iter)
         wallclock_start = time.perf_counter()
 
         while self.end_when is None or not self.end_when(driver_state):
             driver_state["is_epoch_end"] = False
+            self._profile_hook(driver_state["neval"])
             t0 = time.perf_counter()
             batch = next(data_iter)
             data, labels = to_jax_batch(batch)
-            data_time = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
             params, mstate, opt_state, loss = jit_step(
                 params, mstate, opt_state, step_rng, data, labels,
                 jnp.asarray(driver_state["epoch"], jnp.int32))
             loss = float(loss)  # blocks; keeps host loop in lockstep
-            step_time = time.perf_counter() - t0
+            t2 = time.perf_counter()
+            device_time = t2 - t1
+            step_time = t2 - t0
             n = int(data.shape[0])
             count_this_epoch += n
+            batches_this_epoch += 1
             driver_state["loss"] = loss
             wallclock = time.perf_counter() - wallclock_start
             logger.info(
                 self._header(driver_state["epoch"], count_this_epoch,
                              epoch_size, driver_state["neval"], wallclock)
                 + f" loss is {loss:.6f}, iteration time is {step_time:.4f}s,"
-                f" data fetch time is {data_time:.4f}s, "
+                f" host input time is {data_time:.4f}s, device step time is "
+                f"{device_time:.4f}s, "
                 f"throughput is {n / max(step_time, 1e-9):.2f} records/second")
-            self.metrics.set("computing time for each iteration", step_time)
-            self.metrics.set("data fetch time", data_time)
+            self.metrics.record("device step time", device_time)
+            self.metrics.record("host input time", data_time)
             driver_state["neval"] += 1
             if count_this_epoch >= epoch_size:
                 driver_state["epoch"] += 1
                 driver_state["is_epoch_end"] = True
                 count_this_epoch = 0
+                batches_this_epoch = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
-            # publish params for validation/checkpoint (rebinds children
-            # too — the old buffers were donated to the jitted step)
-            model.sync(params, mstate)
-            self._validate(jit_eval, params, mstate, driver_state)
-            self._checkpoint(driver_state)
+            fire_val, fire_ckpt = self._fires(driver_state)
+            if fire_val or fire_ckpt:
+                # publish params only when validation/checkpoint will read
+                # them (syncing the whole module tree every iteration is
+                # pure host overhead on deep models)
+                model.sync(params, mstate)
+            self._validate(jit_eval, params, mstate, driver_state,
+                           fire=fire_val)
+            self._checkpoint(driver_state, opt_state, rng,
+                             count_this_epoch, batches_this_epoch,
+                             fire=fire_ckpt)
 
+        self._stop_profiler()
         model.sync(params, mstate)
         model.evaluate()
         return model

@@ -1,0 +1,163 @@
+"""Checkpoint/resume fidelity tests (VERDICT round-1 weak #1; reference
+DistriOptimizer.scala:319-341 checkpoints the full state Table).
+
+The property under test: a run killed at iteration k and resumed from its
+checkpoint produces EXACTLY the loss trajectory of an uninterrupted run —
+which requires optimizer state (momentum), device rng, host rng, shuffle
+permutation, and mid-epoch data position to all round-trip.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample, array, SampleToBatch
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils import file as bfile
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def make_dataset(n=128, num_shards=None):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    return array([Sample(x[i], y[i]) for i in range(n)],
+                 num_shards=num_shards)
+
+
+def make_model():
+    return nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Dropout(0.2),
+                         nn.Linear(16, 2), nn.LogSoftMax())
+
+
+class _LossRecorder(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "loss is" in msg:
+            self.losses.append(float(
+                msg.split("loss is ")[1].split(",")[0]))
+
+
+def _run(total_iters, ckpt_dir=None, ckpt_every=None, resume_from=None,
+         distri=False):
+    RandomGenerator.set_seed(5)
+    rec = _LossRecorder()
+    logger = logging.getLogger("bigdl_tpu.optim")
+    logger.addHandler(rec)
+    logger.setLevel(logging.INFO)
+    try:
+        if distri:
+            Engine.reset()
+            Engine.init()
+        ds = make_dataset(num_shards=1 if distri else None) \
+            >> SampleToBatch(16, drop_remainder=True)
+        if resume_from is not None:
+            model = bfile.load_module(f"{resume_from[0]}/model.{resume_from[1]}")
+            state = bfile.load(f"{resume_from[0]}/state.{resume_from[1]}")
+        else:
+            model = make_model()
+            state = None
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+        if state is not None:
+            o.set_state(state)
+        if ckpt_dir is not None:
+            o.set_checkpoint(str(ckpt_dir),
+                             optim.several_iteration(ckpt_every))
+        o.set_end_when(optim.max_iteration(total_iters))
+        o.optimize()
+    finally:
+        logger.removeHandler(rec)
+    return rec.losses
+
+
+@pytest.mark.parametrize("distri", [False, True],
+                         ids=["local", "distri-8dev"])
+def test_kill_and_resume_matches_uninterrupted(tmp_path, distri):
+    """Kill at iteration 10 (mid-epoch 2 — past a shuffle), resume, and the
+    remaining losses must match the uninterrupted run exactly."""
+    full = _run(16, distri=distri)
+    assert len(full) == 16
+
+    # several_iteration(10) fires when the post-increment neval hits 10,
+    # i.e. after 9 completed steps — the snapshot is model.10/state.10
+    ck = tmp_path / ("distri" if distri else "local")
+    first = _run(10, ckpt_dir=ck, ckpt_every=10, distri=distri)
+    np.testing.assert_allclose(first, full[:10], rtol=1e-6)
+
+    resumed = _run(16, resume_from=(str(ck), 10), distri=distri)
+    assert len(resumed) == 7
+    np.testing.assert_allclose(resumed, full[9:], rtol=1e-5)
+
+
+def test_checkpoint_contains_full_state(tmp_path):
+    _run(8, ckpt_dir=tmp_path, ckpt_every=4)
+    state = bfile.load(f"{tmp_path}/state.4")
+    assert "opt_state" in state and "velocity" in state["opt_state"]
+    assert "rng" in state
+    assert "host_rng_state" in state
+    assert "data_position" in state
+    assert "batches_this_epoch" in state
+    assert int(np.asarray(state["opt_state"]["neval"])) == 3
+
+
+def test_metrics_honest_phase_names_and_stats(tmp_path):
+    """Optimizers record measurable phases under honest names (VERDICT
+    round-1 weak #3): host input time + device step time, with
+    distribution stats as the straggler-diagnostic replacement."""
+    model = make_model()
+    ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+    o = optim.Optimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_end_when(optim.max_iteration(6))
+    o.optimize()
+    s = o.metrics.stats("device step time")
+    assert s["n"] == 6 and s["max"] >= s["p50"] > 0
+    assert o.metrics.stats("host input time")["n"] == 6
+    summary = o.metrics.summary()
+    assert "device step time" in summary and "p95=" in summary
+    # reference phase names that don't exist under XLA must NOT be reused
+    assert "get weights average" not in summary
+    assert "computing time for each node" not in summary
+
+
+def test_profiler_trace_hook(tmp_path):
+    """set_profiler captures a jax.profiler trace window (SURVEY §7.7)."""
+    model = make_model()
+    ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+    o = optim.Optimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_profiler(str(tmp_path / "trace"), start_iteration=2,
+                   num_iterations=2)
+    o.set_end_when(optim.max_iteration(5))
+    o.optimize()
+    import os
+    found = [f for _, _, fs in os.walk(tmp_path / "trace") for f in fs]
+    assert found, "no trace files written"
+
+
+def test_legacy_state_resume_still_works(tmp_path):
+    """States without opt_state (pre-round-2 checkpoints) keep the
+    epoch/neval LR-counter reconstruction path."""
+    model = make_model()
+    ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+    o = optim.Optimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_state({"epoch": 2, "neval": 9})
+    o.set_end_when(optim.max_iteration(10))
+    trained = o.optimize()
+    assert trained is model
