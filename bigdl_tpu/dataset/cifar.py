@@ -9,11 +9,15 @@ import numpy as np
 
 from bigdl_tpu.dataset.image.types import LabeledBGRImage
 
-__all__ = ["load_bin", "load_folder", "TRAIN_MEAN", "TRAIN_STD"]
+__all__ = ["load_bin", "load_folder", "TRAIN_MEAN", "TRAIN_STD",
+           "TEST_MEAN", "TEST_STD"]
 
-# reference models/vgg/Utils.scala trainMean/trainStd ((R,G,B) of [0,255])
+# reference models/vgg/Utils.scala trainMean/trainStd/testMean/testStd
+# ((R,G,B), scaled to the [0,255] pixel range this reader emits)
 TRAIN_MEAN = (125.33761, 122.96133, 113.8664)
 TRAIN_STD = (62.99322675508508, 62.08871334906125, 66.70490641235472)
+TEST_MEAN = (126.02464429303008, 123.70850706950385, 114.85432115955024)
+TEST_STD = (62.89639202540039, 61.93752790239704, 66.7060575695284)
 
 
 def load_bin(path: str):

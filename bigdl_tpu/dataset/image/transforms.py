@@ -448,8 +448,10 @@ class MTImgToBatch(Transformer):
                     while next_seq in pending:
                         out_q.put(self._assemble(pending.pop(next_seq)))
                         next_seq += 1
-                for seq in sorted(pending):
-                    out_q.put(self._assemble(pending[seq]))
+                # seqs are claimed contiguously and every claimed chunk is
+                # enqueued before its worker's stop marker, so the in-order
+                # drain above must have emptied pending
+                assert not pending, f"unflushed chunks: {sorted(pending)}"
                 for t in threads:
                     t.join()
             finally:

@@ -1,0 +1,63 @@
+"""ImagePredictor — batch image classification with a trained model.
+
+Reference parity: example/imageclassification/ImagePredictor.scala — the
+DLClassifier inference showcase: read an image folder (no labels), run the
+published ResNet-style preprocessing, predict a class per image, print the
+first ``--showNum`` (imageName, predict) pairs.
+
+The DataFrame + DLClassifier machinery maps to the Predictor API: the
+ModelBroadcast role is mesh params replication, the batched forward+argmax
+is Predictor.predict_class.
+
+Run::
+
+    python -m bigdl_tpu.examples.imageclassification.image_predictor \
+        --modelPath model.bigdl -f <image_folder> [--showNum 100]
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.examples.imageclassification")
+
+__all__ = ["main", "predict_folder"]
+
+
+def predict_folder(model, folder: str, batch_size: int = 32, mesh=None):
+    """Returns [(image_name, predicted_class)] for every image file; the
+    preprocessing recipe is the shared ResNetPreprocessor definition."""
+    from bigdl_tpu.examples.loadmodel.dataset_util import ResNetPreprocessor
+    from bigdl_tpu.optim import Predictor
+
+    paths = sorted(str(p) for p in Path(folder).iterdir() if p.is_file())
+    pairs = [(p, 0.0) for p in paths]   # hasLabel=false (reference :66)
+    ds = ResNetPreprocessor(pairs, batch_size)
+    classes = Predictor(model, batch_size, mesh=mesh).predict_class(ds)
+    return list(zip((Path(p).name for p in paths), classes.tolist()))
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("Predict with trained model")
+    p.add_argument("-f", "--folder", required=True,
+                   help="image folder (flat, no labels)")
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--showNum", type=int, default=100)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.utils import file as bfile
+
+    model = bfile.load_module(args.modelPath)
+    results = predict_folder(model, args.folder, args.batchSize)
+    for name, cls in results[:args.showNum]:
+        print(f"[{name},{cls}]")
+    return results
+
+
+if __name__ == "__main__":
+    main()

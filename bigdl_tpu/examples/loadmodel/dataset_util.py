@@ -20,8 +20,12 @@ __all__ = ["AlexNetPreprocessor", "InceptionPreprocessor",
            "ResNetPreprocessor"]
 
 
-def _paths_dataset(folder: str):
-    return LocalArrayDataSet(LocalImageFiles.paths(folder))
+def _paths_dataset(source):
+    """``source``: a class-per-subfolder tree path, or a pre-built list of
+    (path, label) pairs (unlabeled flows pass label 0.0)."""
+    if isinstance(source, (str, Path)):
+        return LocalArrayDataSet(LocalImageFiles.paths(str(source)))
+    return LocalArrayDataSet(list(source))
 
 
 def AlexNetPreprocessor(path: str, batch_size: int, mean_file: str):
@@ -45,10 +49,11 @@ def InceptionPreprocessor(path: str, batch_size: int):
             >> BGRImgToBatch(batch_size))
 
 
-def ResNetPreprocessor(path: str, batch_size: int):
+def ResNetPreprocessor(source, batch_size: int):
     """Shorter-side-256 resize, 224 center crop, ImageNet mean/std on [0,1]
-    pixels (reference DatasetUtil.scala:62-80)."""
-    return (_paths_dataset(str(path))
+    pixels (reference DatasetUtil.scala:62-80). ``source``: folder tree or
+    (path, label) pairs — the single shared definition of this recipe."""
+    return (_paths_dataset(source)
             >> LocalImgReader(256)
             >> BGRImgCropper(224, 224, CropCenter)
             >> BGRImgNormalizer(0.485, 0.456, 0.406, 0.229, 0.224, 0.225)

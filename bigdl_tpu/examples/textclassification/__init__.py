@@ -1,0 +1,2 @@
+from bigdl_tpu.examples.textclassification.text_classifier import (
+    TextClassifier, build_model, to_tokens, shaping, vectorization)

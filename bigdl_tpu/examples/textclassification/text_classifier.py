@@ -1,0 +1,217 @@
+"""Text classification on 20 Newsgroups with pre-trained GloVe vectors.
+
+Reference parity: example/textclassification/TextClassifier.scala:40-230 +
+SimpleTokenizer (TextTransformer.scala:18-80) — the BASELINE tracked config
+#5 proof that the stack composes: ~90% accuracy after a few epochs with the
+published recipe (GloVe-100d vectorization -> 3x[conv5 + maxpool] CNN ->
+Linear(128,100) -> Linear(100, classNum), Adagrad lr 0.01 decay 2e-4).
+
+TPU-first notes: the reference vectorizes on Spark executors and trains
+batch 128 through DistriOptimizer; here vectorization is host numpy and the
+model trains through the jitted Local/Distri optimizer path. The conv stack
+is NCHW (B, embedding, 1, seq) exactly like the reference's
+SpatialConvolution usage, so the MXU sees a dense 2-D conv.
+
+Run::
+
+    python -m bigdl_tpu.examples.textclassification.text_classifier \
+        --baseDir <dir>     # containing 20_newsgroup/ and glove.6B/
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import re
+from collections import Counter
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.examples.textclassification")
+
+__all__ = ["TextClassifier", "build_model", "to_tokens", "shaping",
+           "vectorization"]
+
+
+# ---------------------------------------------------------------------------
+# SimpleTokenizer (reference TextTransformer.scala:18-80)
+# ---------------------------------------------------------------------------
+
+def to_tokens(text: str) -> list[str]:
+    """Split on non-letters, lowercase, keep tokens longer than 2 chars."""
+    return [t for t in re.sub("[^a-zA-Z]", " ", text).lower().split()
+            if len(t) > 2]
+
+
+def shaping(tokens: list, sequence_len: int, trunc: str = "pre") -> list:
+    """Pad with 0 / truncate (``pre`` keeps the tail) to sequence_len."""
+    if len(tokens) > sequence_len:
+        return (tokens[-sequence_len:] if trunc == "pre"
+                else tokens[:sequence_len])
+    return list(tokens) + [0] * (sequence_len - len(tokens))
+
+
+def vectorization(indices: list, embedding_dim: int,
+                  word2vec: dict) -> np.ndarray:
+    """Index sequence -> (seq_len, embedding_dim); unknown words are
+    zero vectors."""
+    out = np.zeros((len(indices), embedding_dim), np.float32)
+    for i, w in enumerate(indices):
+        vec = word2vec.get(w)
+        if vec is not None:
+            out[i] = vec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# model (reference TextClassifier.buildModel, :122-144)
+# ---------------------------------------------------------------------------
+
+def build_model(class_num: int, embedding_dim: int = 100,
+                sequence_len: int = 1000):
+    from bigdl_tpu.nn import (Linear, LogSoftMax, ReLU, Reshape, Sequential,
+                              SpatialConvolution, SpatialMaxPooling)
+    # pool sizes follow the reference for seq_len 1000; for shorter test
+    # sequences scale the final catch-all pool to whatever length remains
+    l1 = (sequence_len - 4) // 5          # after conv5 + pool5
+    l2 = (l1 - 4) // 5                    # after second conv5 + pool5
+    l3 = l2 - 4                           # after third conv5
+    model = Sequential()
+    model.add(Reshape((embedding_dim, 1, sequence_len), batch_mode=True))
+    model.add(SpatialConvolution(embedding_dim, 128, 5, 1))
+    model.add(ReLU())
+    model.add(SpatialMaxPooling(5, 1, 5, 1))
+    model.add(SpatialConvolution(128, 128, 5, 1))
+    model.add(ReLU())
+    model.add(SpatialMaxPooling(5, 1, 5, 1))
+    model.add(SpatialConvolution(128, 128, 5, 1))
+    model.add(ReLU())
+    model.add(SpatialMaxPooling(l3, 1, l3, 1))
+    model.add(Reshape((128,), batch_mode=True))
+    model.add(Linear(128, 100))
+    model.add(Linear(100, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+# ---------------------------------------------------------------------------
+# the example driver (reference TextClassifier class)
+# ---------------------------------------------------------------------------
+
+class TextClassifier:
+    def __init__(self, base_dir: str, max_sequence_length: int = 1000,
+                 max_words_num: int = 20000, training_split: float = 0.8,
+                 batch_size: int = 128, embedding_dim: int = 100,
+                 drop_top_words: int = 10):
+        self.base_dir = base_dir
+        self.glove_dir = os.path.join(base_dir, "glove.6B")
+        self.text_dir = os.path.join(base_dir, "20_newsgroup")
+        self.max_sequence_length = max_sequence_length
+        self.max_words_num = max_words_num
+        self.training_split = training_split
+        self.batch_size = batch_size
+        self.embedding_dim = embedding_dim
+        self.drop_top_words = drop_top_words
+        self.class_num = -1
+
+    def load_raw_data(self) -> list[tuple[str, float]]:
+        """Category-per-subfolder tree of digit-named files
+        (reference :72-97)."""
+        out = []
+        categories = sorted(p for p in Path(self.text_dir).iterdir()
+                            if p.is_dir())
+        for label, cat in enumerate(categories, start=1):
+            for f in sorted(p for p in cat.iterdir()
+                            if p.is_file() and p.name.isdigit()):
+                out.append((f.read_text(encoding="ISO-8859-1",
+                                        errors="replace"), float(label)))
+        self.class_num = len(categories)
+        logger.info("Found %d texts across %d classes", len(out),
+                    self.class_num)
+        return out
+
+    def analyze_texts(self, data: list[tuple[str, float]]):
+        """Frequency-rank the vocabulary, drop the ~10 most frequent words,
+        keep max_words_num (reference :103-117); then index the GloVe
+        vectors for the kept words (reference buildWord2Vec, :44-60)."""
+        freq = Counter()
+        for text, _ in data:
+            freq.update(to_tokens(text))
+        ranked = freq.most_common()[self.drop_top_words:self.max_words_num]
+        word2index = {w: i + 1 for i, (w, _) in enumerate(ranked)}
+        word2vec = {}
+        glove_path = os.path.join(self.glove_dir,
+                                  f"glove.6B.{self.embedding_dim}d.txt")
+        with open(glove_path, encoding="ISO-8859-1") as f:
+            for line in f:
+                values = line.rstrip().split(" ")
+                idx = word2index.get(values[0])
+                if idx is not None:
+                    word2vec[idx] = np.asarray(values[1:], np.float32)
+        logger.info("Found %d word vectors of %d indexed words",
+                    len(word2vec), len(word2index))
+        return word2index, word2vec
+
+    def make_samples(self, data, word2index, word2vec):
+        from bigdl_tpu.dataset.sample import Sample
+        samples = []
+        for text, label in data:
+            idxs = [word2index[t] for t in to_tokens(text)
+                    if t in word2index]
+            idxs = shaping(idxs, self.max_sequence_length)
+            feat = vectorization(idxs, self.embedding_dim, word2vec)
+            # (seq, emb) -> (emb, seq), the reference's transpose(1,2)
+            samples.append(Sample(feat.T.copy(), label))
+        return samples
+
+    def train(self, max_epoch: int = 20, mesh=None):
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import array, SampleToBatch
+        from bigdl_tpu.optim import (Adagrad, Optimizer, Top1Accuracy,
+                                     every_epoch, max_epoch as max_epoch_t)
+        from bigdl_tpu.utils.random import RandomGenerator
+
+        data = self.load_raw_data()
+        word2index, word2vec = self.analyze_texts(data)
+        samples = self.make_samples(data, word2index, word2vec)
+        RandomGenerator.RNG().shuffle(samples)
+        split = int(len(samples) * self.training_split)
+        train_set = array(samples[:split]) >> SampleToBatch(
+            self.batch_size, drop_remainder=True)
+        val_set = array(samples[split:] or samples[:1]) >> SampleToBatch(
+            self.batch_size)
+
+        model = build_model(self.class_num, self.embedding_dim,
+                            self.max_sequence_length)
+        optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(),
+                              mesh=mesh)
+        # reference state: lr 0.01, decay 0.0002, Adagrad (:178-186)
+        optimizer.set_optim_method(
+            Adagrad(learning_rate=0.01, learning_rate_decay=0.0002))
+        optimizer.set_validation(every_epoch(), val_set, [Top1Accuracy()])
+        optimizer.set_end_when(max_epoch_t(max_epoch))
+        trained = optimizer.optimize()
+        return trained, optimizer
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("bigdl_tpu text classification")
+    p.add_argument("-b", "--baseDir", required=True,
+                   help="dir containing 20_newsgroup/ and glove.6B/")
+    p.add_argument("--maxSequenceLength", type=int, default=1000)
+    p.add_argument("--maxWordsNum", type=int, default=20000)
+    p.add_argument("--trainingSplit", type=float, default=0.8)
+    p.add_argument("--batchSize", type=int, default=128)
+    p.add_argument("--embeddingDim", type=int, default=100)
+    p.add_argument("-e", "--maxEpoch", type=int, default=20)
+    args = p.parse_args(argv)
+    tc = TextClassifier(args.baseDir, args.maxSequenceLength,
+                        args.maxWordsNum, args.trainingSplit,
+                        args.batchSize, args.embeddingDim)
+    tc.train(max_epoch=args.maxEpoch)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from bigdl_tpu.models.alexnet.model import AlexNet, AlexNet_OWT

@@ -1,0 +1,44 @@
+"""AlexNet ImageNet evaluation main (mirrors the reference's per-model Test
+shape, models/*/Test.scala; AlexNet lives in example/loadmodel there).
+
+Run: ``python -m bigdl_tpu.models.alexnet.test -f <dir> --model <snap>``.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_test_parser("Test AlexNet on ImageNet")
+    parser.add_argument("--meanFile", default=None,
+                        help=".npy per-pixel mean (AlexNet preprocessing)")
+    args = parser.parse_args(argv)
+    mesh = init_engine()
+
+    from bigdl_tpu.examples.loadmodel.dataset_util import (
+        AlexNetPreprocessor, ResNetPreprocessor)
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy, Validator
+    from bigdl_tpu.utils import file as bfile
+
+    import os
+    val_path = os.path.join(args.folder, "val")
+    if not os.path.isdir(val_path):
+        val_path = args.folder
+    if args.meanFile:
+        val_set = AlexNetPreprocessor(val_path, args.batchSize,
+                                      args.meanFile)
+    else:
+        val_set = ResNetPreprocessor(val_path, args.batchSize)
+
+    model = bfile.load_module(args.model)
+    results = Validator(model, val_set, mesh=mesh).test(
+        [Top1Accuracy(), Top5Accuracy()])
+    for result, method in results:
+        print(f"{method!r} is {result!r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Inception ImageNet evaluation main (reference models/inception/Test.scala).
+
+Run: ``python -m bigdl_tpu.models.inception.test -f <imagenet_dir> --model
+<snap>`` — ``--folder`` holds a ``val/`` class-per-subfolder tree (or is
+itself such a tree).
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    args = base_test_parser("Test Inception on ImageNet").parse_args(argv)
+    mesh = init_engine()
+
+    from bigdl_tpu.models.inception.train import build_pipeline
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy, Validator
+    from bigdl_tpu.utils import file as bfile
+
+    val_set = build_pipeline(args.folder, args.batchSize, train=False)
+    model = bfile.load_module(args.model)
+    results = Validator(model, val_set, mesh=mesh).test(
+        [Top1Accuracy(), Top5Accuracy()])
+    for result, method in results:
+        print(f"{method!r} is {result!r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""SimpleRNN text-generation main (reference models/rnn/Test.scala:38-92 —
+load the saved Dictionary, read seed sentences from ``test.txt``, and
+repeatedly sample the next word from the model's softmax distribution,
+appending ``--numOfWords`` words per sentence).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+logger = logging.getLogger("bigdl_tpu.models.rnn")
+
+
+def generate(model, dictionary, token_lists, num_words: int):
+    """Autoregressive sampling loop (reference Test.scala:60-92: forward,
+    softmax at the last step, inverse-CDF sample against a uniform)."""
+    from bigdl_tpu.utils.random import RandomGenerator
+    vocab = dictionary.get_vocab_size() + 1
+    rng = RandomGenerator.RNG()
+    seqs = [[dictionary.get_index(w) for w in toks] for toks in token_lists]
+    for _ in range(num_words):
+        nxt = []
+        for seq in seqs:
+            onehot = np.zeros((1, len(seq), vocab), np.float32)
+            onehot[0, np.arange(len(seq)), np.asarray(seq, int)] = 1.0
+            out = np.asarray(model.forward(onehot))     # (1, T, V) log-probs
+            probs = np.exp(out[0, -1])
+            probs = probs / probs.sum()
+            cdf = np.cumsum(probs)
+            # clamp: float32 rounding can leave cdf[-1] just under 1.0, and
+            # searchsorted == len(cdf) would overflow the one-hot dim
+            nxt.append(min(int(np.searchsorted(cdf, float(rng.uniform()))),
+                           vocab - 1))
+        seqs = [s + [w] for s, w in zip(seqs, nxt)]
+    return [[dictionary.get_word(min(w, dictionary.get_vocab_size() - 1))
+             for w in seq] for seq in seqs]
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_test_parser("Test SimpleRNN LM (text generation)")
+    parser.add_argument("--numOfWords", type=int, default=10)
+    args = parser.parse_args(argv)
+    init_engine()
+
+    from bigdl_tpu.dataset.text import (Dictionary, SentenceSplitter,
+                                        SentenceTokenizer)
+    from bigdl_tpu.utils import file as bfile
+
+    dictionary = Dictionary.load(args.folder)
+    with open(os.path.join(args.folder, "test.txt")) as f:
+        text = f.read()
+    sentences = list(SentenceSplitter()(iter([text])))
+    tokens = list(SentenceTokenizer()(iter(sentences)))
+
+    model = bfile.load_module(args.model)
+    model.evaluate()
+    results = generate(model, dictionary, tokens, args.numOfWords)
+    for words in results:
+        logger.info(",".join(words))
+        print(" ".join(words))
+    return results
+
+
+if __name__ == "__main__":
+    main()

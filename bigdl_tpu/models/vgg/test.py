@@ -1,0 +1,35 @@
+"""VGG CIFAR-10 evaluation main (reference models/vgg/Test.scala:26-56).
+
+Run: ``python -m bigdl_tpu.models.vgg.test -f <cifar_dir> --model <snap>``.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    args = base_test_parser("Test Vgg on Cifar10").parse_args(argv)
+    mesh = init_engine()
+
+    from bigdl_tpu.dataset import cifar
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.image import BGRImgNormalizer, BGRImgToBatch
+    from bigdl_tpu.optim import Top1Accuracy, Validator
+    from bigdl_tpu.utils import file as bfile
+
+    val = LocalArrayDataSet(cifar.load_folder(args.folder, train=False))
+    val_set = val >> BGRImgNormalizer(cifar.TEST_MEAN,
+                                      std_r=cifar.TEST_STD) \
+        >> BGRImgToBatch(args.batchSize)
+
+    model = bfile.load_module(args.model)
+    results = Validator(model, val_set, mesh=mesh).test([Top1Accuracy()])
+    for result, method in results:
+        print(f"{method!r} is {result!r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

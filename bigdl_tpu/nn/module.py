@@ -233,6 +233,26 @@ class Module:
         _file.save_module(self, path, overwrite=overwrite)
         return self
 
+    def save_torch(self, path: str, overwrite: bool = False):
+        """Export as a Torch .t7 file (reference AbstractModule.saveTorch,
+        :311-315)."""
+        from bigdl_tpu.utils import torchfile
+        torchfile.save_torch(self, path, overwrite)
+        return self
+
+    @staticmethod
+    def load_torch(path: str):
+        """(reference Module.loadTorch, nn/Module.scala:31-33)"""
+        from bigdl_tpu.utils import torchfile
+        return torchfile.load_torch(path)
+
+    @staticmethod
+    def load_caffe(model, def_path: str, model_path: str,
+                   match_all: bool = True):
+        """(reference Module.loadCaffe, nn/Module.scala:35-39)"""
+        from bigdl_tpu.utils.caffe import load_caffe
+        return load_caffe(model, def_path, model_path, match_all)
+
     def __repr__(self):
         return f"{type(self).__name__}()"
 

@@ -12,4 +12,6 @@ from bigdl_tpu.optim.validation import (ValidationMethod, ValidationResult,
                                         Top1Accuracy, Top5Accuracy, Loss)
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
-from bigdl_tpu.optim.validator import Validator, LocalValidator
+from bigdl_tpu.optim.validator import (Validator, LocalValidator,
+                                       DistriValidator)
+from bigdl_tpu.optim.predictor import Predictor

@@ -133,6 +133,7 @@ class DistriOptimizer(Optimizer):
             out = jit_eval(p, s, jax.device_put(d, batch_shard))
             return np.asarray(out)[:n]
 
+        epoch_start_host_rng = self._host_rng_snapshot()
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         batches_this_epoch = batches_to_skip
@@ -189,6 +190,7 @@ class DistriOptimizer(Optimizer):
                 count_this_epoch = 0
                 batches_this_epoch = 0
                 self.dataset.shuffle()
+                epoch_start_host_rng = self._host_rng_snapshot()
                 data_iter = self.dataset.data(train=True)
             fire_val, fire_ckpt = self._fires(driver_state)
             if fire_val or fire_ckpt:
@@ -199,7 +201,7 @@ class DistriOptimizer(Optimizer):
                            fire=fire_val)
             self._checkpoint(driver_state, opt_state, rng,
                              count_this_epoch, batches_this_epoch,
-                             fire=fire_ckpt)
+                             epoch_start_host_rng, fire=fire_ckpt)
 
         self._stop_profiler()
         model.sync(params, mstate)

@@ -138,8 +138,20 @@ class Optimizer:
             logger.info(f"{m!r} is {r!r}")
         return dict(zip([repr(m) for m in self.validation_methods], results))
 
+    @staticmethod
+    def _host_rng_snapshot() -> bytes:
+        """Pickled host-RNG state. Captured at each training-iterator
+        (re)creation: mid-epoch resume restores THIS state and replays the
+        consumed batches, so the pipeline's random-augmentation draws land
+        exactly where the uninterrupted run's did (restoring the
+        checkpoint-time state would double-consume the replayed draws)."""
+        import pickle
+        from bigdl_tpu.utils.random import RandomGenerator
+        return pickle.dumps(RandomGenerator.RNG()._rng.bit_generator.state)
+
     def _checkpoint(self, driver_state, opt_state=None, rng=None,
-                    record_count=0, batches_this_epoch=0, *,
+                    record_count=0, batches_this_epoch=0,
+                    epoch_start_host_rng: bytes | None = None, *,
                     fire: bool | None = None):
         """Save the WHOLE training state on trigger (reference
         DistriOptimizer.scala:319-341 saves the full state Table): driver
@@ -154,7 +166,6 @@ class Optimizer:
         if not fire:
             return
         from bigdl_tpu.utils import file as _file
-        from bigdl_tpu.utils.random import RandomGenerator
         neval = driver_state["neval"]
         suffix = "" if self.is_overwrite else f".{neval}"
         _file.save_module(self.model,
@@ -168,11 +179,11 @@ class Optimizer:
                 lambda v: np.asarray(v), opt_state)
         if rng is not None:
             full_state["rng"] = np.asarray(rng)
-        import pickle
         # opaque bytes: the nested state dict (strings/ints/arrays) must
         # round-trip exactly, not through the array-flattening save path
-        full_state["host_rng_state"] = pickle.dumps(
-            RandomGenerator.RNG()._rng.bit_generator.state)
+        full_state["host_rng_state"] = (epoch_start_host_rng
+                                        if epoch_start_host_rng is not None
+                                        else self._host_rng_snapshot())
         pos = self.dataset.get_position_state()
         if pos is not None:
             full_state["data_position"] = pos
@@ -295,6 +306,7 @@ class LocalOptimizer(Optimizer):
 
         jit_eval = jax.jit(eval_apply)
 
+        epoch_start_host_rng = self._host_rng_snapshot()
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         batches_this_epoch = batches_to_skip
@@ -339,6 +351,7 @@ class LocalOptimizer(Optimizer):
                 count_this_epoch = 0
                 batches_this_epoch = 0
                 self.dataset.shuffle()
+                epoch_start_host_rng = self._host_rng_snapshot()
                 data_iter = self.dataset.data(train=True)
             fire_val, fire_ckpt = self._fires(driver_state)
             if fire_val or fire_ckpt:
@@ -350,7 +363,7 @@ class LocalOptimizer(Optimizer):
                            fire=fire_val)
             self._checkpoint(driver_state, opt_state, rng,
                              count_this_epoch, batches_this_epoch,
-                             fire=fire_ckpt)
+                             epoch_start_host_rng, fire=fire_ckpt)
 
         self._stop_profiler()
         model.sync(params, mstate)

@@ -8,10 +8,11 @@ the ValidationResults.
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet, to_jax_batch
 
-__all__ = ["Validator", "LocalValidator"]
+__all__ = ["Validator", "LocalValidator", "DistriValidator"]
 
 
 class LocalValidator:
@@ -42,7 +43,62 @@ class LocalValidator:
         return list(zip(results, methods))
 
 
-def Validator(model, dataset: AbstractDataSet):
+class DistriValidator:
+    """Standalone evaluation over the device mesh (reference
+    optim/DistriValidator.scala:29-80 — broadcast eval-mode model, clone
+    per core, map-reduce over the rdd).
+
+    TPU-native: params replicated over the mesh, batches sharded along the
+    data axis (padded to the mesh multiple and the padding masked out of
+    the reduction), ValidationResults monoid-reduced exactly like the
+    reference's cross-partition reduce.
+    """
+
+    def __init__(self, model, dataset: AbstractDataSet, mesh=None):
+        from bigdl_tpu.parallel.engine import (data_sharding, get_mesh,
+                                               replicated)
+        self.model = model
+        self.dataset = dataset
+        self.mesh = mesh or get_mesh()
+        self._repl = replicated(self.mesh)
+        self._shard = data_sharding(self.mesh)
+        self._n_shards = int(np.prod(self.mesh.devices.shape))
+
+    def test(self, methods):
+        model = self.model
+        model.materialize()
+        model.evaluate()
+        params = jax.device_put(model.params, self._repl)
+        mstate = jax.device_put(model.state, self._repl)
+
+        @jax.jit
+        def eval_apply(p, s, data):
+            out, _ = model.apply(p, s, data, training=False)
+            return out
+
+        results = [None] * len(methods)
+        for batch in self.dataset.data(train=False):
+            data = np.asarray(batch.data)
+            n = data.shape[0]
+            pad = (-n) % self._n_shards
+            if pad:
+                data = np.concatenate(
+                    [data, np.repeat(data[-1:], pad, axis=0)])
+            out = eval_apply(params, mstate,
+                             jax.device_put(data, self._shard))
+            out = np.asarray(out)[:n]
+            import jax.numpy as jnp
+            labels = jnp.asarray(batch.labels)
+            for i, m in enumerate(methods):
+                r = m(jnp.asarray(out), labels)
+                results[i] = r if results[i] is None else results[i] + r
+        return list(zip(results, methods))
+
+
+def Validator(model, dataset: AbstractDataSet, mesh=None):
     """Factory (reference optim/Validator.scala:51 — dispatch on dataset
-    type; the sharded eval path reuses LocalValidator per shard)."""
+    type: sharded datasets / an explicit mesh get the DistriValidator)."""
+    if mesh is not None or (hasattr(dataset, "is_sharded")
+                            and dataset.is_sharded()):
+        return DistriValidator(model, dataset, mesh)
     return LocalValidator(model, dataset)

@@ -4,3 +4,5 @@ dl/.../bigdl/utils/)."""
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils import file  # noqa: F401
+from bigdl_tpu.utils.caffe import load_caffe
+from bigdl_tpu.utils.torchfile import load_torch, save_torch

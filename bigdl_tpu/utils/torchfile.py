@@ -1,0 +1,525 @@
+"""Torch7 ``.t7`` binary reader/writer — pure Python.
+
+Reference parity: utils/TorchFile.scala:35-1047 — the binary-compatible
+Torch serialization used for Torch interop (``Module.loadTorch`` /
+``saveTorch``) and test fixtures. Format (little-endian):
+
+    object   := int32 type, payload
+    type     := NIL 0 | NUMBER 1 | STRING 2 | TABLE 3 | TORCH 4 | BOOLEAN 5
+    NUMBER   := float64
+    STRING   := int32 len, bytes
+    BOOLEAN  := int32 (1/0)
+    TABLE    := int32 index, int32 size, size * (object key, object value)
+    TORCH    := int32 index, STRING version ("V 1"), STRING class, body
+    Tensor   := int32 ndim, int64[ndim] size, int64[ndim] stride,
+                int64 storageOffset (1-based), object storage
+    Storage  := int64 size, raw elements
+
+Indices form a shared-object registry: a TORCH/TABLE with an
+already-seen index is a back-reference (TorchFile.scala:213-249).
+
+Supported module classes cover the model-zoo set (Sequential, Linear,
+SpatialConvolution(+MM), pooling, ReLU/Tanh/Sigmoid/LogSoftMax, View,
+Reshape, Dropout, (Spatial)BatchNormalization, Threshold, CAddTable,
+ConcatTable, Concat) — tensors map to/from numpy, torch (out,in[,kH,kW])
+layouts match this repo's parameter layouts directly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any
+
+import numpy as np
+
+__all__ = ["load", "save", "load_torch", "save_torch", "TorchTable"]
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+TYPE_FUNCTION = 6
+LEGACY_TYPE_RECUR_FUNCTION = 7
+TYPE_RECUR_FUNCTION = 8
+
+_STORAGE_DTYPES = {
+    "torch.FloatStorage": np.float32, "torch.CudaStorage": np.float32,
+    "torch.DoubleStorage": np.float64, "torch.CudaDoubleStorage": np.float64,
+    "torch.LongStorage": np.int64, "torch.CudaLongStorage": np.int64,
+    "torch.IntStorage": np.int32, "torch.ByteStorage": np.uint8,
+    "torch.CharStorage": np.int8, "torch.ShortStorage": np.int16,
+}
+_TENSOR_DTYPES = {
+    "torch.FloatTensor": np.float32, "torch.CudaTensor": np.float32,
+    "torch.DoubleTensor": np.float64, "torch.CudaDoubleTensor": np.float64,
+    "torch.LongTensor": np.int64, "torch.CudaLongTensor": np.int64,
+    "torch.IntTensor": np.int32, "torch.ByteTensor": np.uint8,
+    "torch.CharTensor": np.int8, "torch.ShortTensor": np.int16,
+}
+
+
+class TorchTable(dict):
+    """A lua table: string and 1-based integer keys. ``array()`` gives the
+    contiguous 1..n slice as a list (module lists etc.)."""
+
+    def array(self) -> list:
+        out = []
+        i = 1
+        while i in self or float(i) in self:
+            out.append(self.get(i, self.get(float(i))))
+            i += 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    def __init__(self, buf: bytes, build_modules: bool):
+        self.buf = buf
+        self.pos = 0
+        self.objects: dict[int, Any] = {}
+        self.build_modules = build_modules
+
+    def _unpack(self, fmt: str, n: int):
+        val = struct.unpack_from("<" + fmt, self.buf, self.pos)[0]
+        self.pos += n
+        return val
+
+    def read_int(self) -> int:
+        return self._unpack("i", 4)
+
+    def read_long(self) -> int:
+        return self._unpack("q", 8)
+
+    def read_number(self) -> float:
+        return self._unpack("d", 8)
+
+    def read_string(self) -> str:
+        n = self.read_int()
+        s = self.buf[self.pos:self.pos + n].decode("latin-1")
+        self.pos += n
+        return s
+
+    def read_storage(self, dtype) -> np.ndarray:
+        n = self.read_long()
+        itemsize = np.dtype(dtype).itemsize
+        arr = np.frombuffer(self.buf, dtype, count=n, offset=self.pos).copy()
+        self.pos += n * itemsize
+        return arr
+
+    def read_tensor(self, dtype) -> np.ndarray:
+        ndim = self.read_int()
+        sizes = [self.read_long() for _ in range(ndim)]
+        strides = [self.read_long() for _ in range(ndim)]
+        offset = self.read_long()          # 1-based
+        storage = self.read_object()
+        if ndim == 0 or storage is None:
+            return np.zeros(sizes, dtype)
+        itemsize = np.dtype(dtype).itemsize
+        view = np.lib.stride_tricks.as_strided(
+            storage[offset - 1:], shape=sizes,
+            strides=[s * itemsize for s in strides])
+        return view.copy()
+
+    def read_table(self) -> TorchTable:
+        size = self.read_int()
+        out = TorchTable()
+        for _ in range(size):
+            k = self.read_object()
+            v = self.read_object()
+            if isinstance(k, float) and k.is_integer():
+                k = int(k)
+            out[k] = v
+        return out
+
+    def read_version_and_class(self) -> tuple[int, str]:
+        """(TorchFile.scala:719-726): 'V <n>' then class, or legacy
+        class-only (version 0)."""
+        s = self.read_string()
+        if s.startswith("V ") and s[2:].isdigit():
+            return int(s[2:]), self.read_string()
+        return 0, s
+
+    def read_object(self) -> Any:
+        type_id = self.read_int()
+        if type_id == TYPE_NIL:
+            return None
+        if type_id == TYPE_NUMBER:
+            return self.read_number()
+        if type_id == TYPE_STRING:
+            return self.read_string()
+        if type_id == TYPE_BOOLEAN:
+            return bool(self.read_int())
+        if type_id == TYPE_TABLE:
+            idx = self.read_int()
+            if idx in self.objects:
+                return self.objects[idx]
+            result = TorchTable()
+            self.objects[idx] = result   # register BEFORE recursing
+            size = self.read_int()
+            for _ in range(size):
+                k = self.read_object()
+                v = self.read_object()
+                if isinstance(k, float) and k.is_integer():
+                    k = int(k)
+                result[k] = v
+            return result
+        if type_id == TYPE_TORCH:
+            idx = self.read_int()
+            if idx in self.objects:
+                return self.objects[idx]
+            _, cls = self.read_version_and_class()
+            if cls in _TENSOR_DTYPES:
+                result = self.read_tensor(_TENSOR_DTYPES[cls])
+            elif cls in _STORAGE_DTYPES:
+                result = self.read_storage(_STORAGE_DTYPES[cls])
+            else:
+                elements = self.read_object()
+                result = (_build_module(cls, elements)
+                          if self.build_modules else elements)
+            self.objects[idx] = result
+            return result
+        raise ValueError(f"unsupported t7 type id {type_id} "
+                         f"at byte {self.pos - 4}")
+
+
+# ---------------------------------------------------------------------------
+# torch table -> bigdl_tpu module (reference readModuleWithType, :135-181)
+# ---------------------------------------------------------------------------
+
+def _set_params(module, **arrays):
+    import jax.numpy as jnp
+    module.materialize()
+    for key, val in arrays.items():
+        if val is not None:
+            module.params[key] = jnp.asarray(
+                np.asarray(val, np.float32).reshape(
+                    module.params[key].shape))
+    return module
+
+
+def _build_module(cls_name: str, e: TorchTable):
+    from bigdl_tpu import nn
+    name = cls_name.replace("cudnn.", "nn.")
+    if name == "nn.Sequential":
+        seq = nn.Sequential()
+        for child in e["modules"].array():
+            seq.add(child)
+        return seq
+    if name == "nn.Concat":
+        c = nn.Concat(int(e["dimension"]) - 1)   # torch dims are 1-based
+        for child in e["modules"].array():
+            c.add(child)
+        return c
+    if name == "nn.ConcatTable":
+        c = nn.ConcatTable()
+        for child in e["modules"].array():
+            c.add(child)
+        return c
+    if name == "nn.Linear":
+        w, b = e["weight"], e.get("bias")
+        m = nn.Linear(w.shape[1], w.shape[0], with_bias=b is not None)
+        return _set_params(m, weight=w, bias=b)
+    if name in ("nn.SpatialConvolution", "nn.SpatialConvolutionMM"):
+        m = nn.SpatialConvolution(
+            int(e["nInputPlane"]), int(e["nOutputPlane"]),
+            int(e["kW"]), int(e["kH"]), int(e.get("dW", 1)),
+            int(e.get("dH", 1)), int(e.get("padW", 0)),
+            int(e.get("padH", 0)),
+            with_bias=e.get("bias") is not None)
+        return _set_params(m, weight=e["weight"], bias=e.get("bias"))
+    if name == "nn.SpatialMaxPooling":
+        m = nn.SpatialMaxPooling(
+            int(e["kW"]), int(e["kH"]), int(e.get("dW", 1)),
+            int(e.get("dH", 1)), int(e.get("padW", 0)),
+            int(e.get("padH", 0)))
+        if e.get("ceil_mode"):
+            m.ceil()
+        return m
+    if name == "nn.SpatialAveragePooling":
+        return nn.SpatialAveragePooling(
+            int(e["kW"]), int(e["kH"]), int(e.get("dW", 1)),
+            int(e.get("dH", 1)), int(e.get("padW", 0)),
+            int(e.get("padH", 0)))
+    if name in ("nn.BatchNormalization", "nn.SpatialBatchNormalization"):
+        import jax.numpy as jnp
+        ctor = (nn.SpatialBatchNormalization
+                if name.endswith("SpatialBatchNormalization")
+                else nn.BatchNormalization)
+        mean = e["running_mean"]
+        m = ctor(int(mean.shape[0]), eps=float(e.get("eps", 1e-5)),
+                 momentum=float(e.get("momentum", 0.1)),
+                 affine=bool(e.get("affine", True)))
+        m = _set_params(m, weight=e.get("weight"), bias=e.get("bias"))
+        m.state["running_mean"] = jnp.asarray(mean, jnp.float32)
+        var = e.get("running_var")
+        if var is not None:
+            m.state["running_var"] = jnp.asarray(var, jnp.float32)
+        return m
+    if name == "nn.ReLU":
+        return nn.ReLU(bool(e.get("inplace", False)))
+    if name == "nn.Tanh":
+        return nn.Tanh()
+    if name == "nn.Sigmoid":
+        return nn.Sigmoid()
+    if name == "nn.LogSoftMax":
+        return nn.LogSoftMax()
+    if name == "nn.SoftMax":
+        return nn.SoftMax()
+    if name == "nn.Threshold":
+        return nn.Threshold(float(e.get("threshold", 1e-6)),
+                            float(e.get("val", 0.0)))
+    if name == "nn.View":
+        sizes = e["size"]
+        sizes = ([int(s) for s in np.asarray(sizes).reshape(-1)]
+                 if not isinstance(sizes, TorchTable)
+                 else [int(s) for s in sizes.array()])
+        return nn.View(*sizes)
+    if name == "nn.Reshape":
+        sizes = e["size"]
+        sizes = ([int(s) for s in np.asarray(sizes).reshape(-1)]
+                 if not isinstance(sizes, TorchTable)
+                 else [int(s) for s in sizes.array()])
+        return nn.Reshape(sizes)
+    if name == "nn.Dropout":
+        return nn.Dropout(float(e.get("p", 0.5)))
+    if name == "nn.CAddTable":
+        return nn.CAddTable()
+    if name == "nn.Identity":
+        return nn.Identity()
+    raise ValueError(f"unsupported torch module {cls_name}")
+
+
+# ---------------------------------------------------------------------------
+# writing
+# ---------------------------------------------------------------------------
+
+class _Writer:
+    def __init__(self):
+        self.parts: list[bytes] = []
+        self.index = 0
+        self.seen: dict[int, int] = {}   # id(obj) -> registry index
+        self._refs: list = []            # pin objects: id() keys must not
+                                         # be reused by freed temporaries
+
+    def put(self, fmt: str, *vals):
+        self.parts.append(struct.pack("<" + fmt, *vals))
+
+    def write_string(self, s: str):
+        raw = s.encode("latin-1")
+        self.put("i", len(raw))
+        self.parts.append(raw)
+
+    def _next_index(self, obj) -> tuple[int, bool]:
+        key = id(obj)
+        if key in self.seen:
+            return self.seen[key], True
+        self.index += 1
+        self.seen[key] = self.index
+        self._refs.append(obj)
+        return self.index, False
+
+    def write_tensor(self, arr: np.ndarray):
+        arr = np.ascontiguousarray(arr)
+        cls = {np.dtype(np.float32): ("torch.FloatTensor",
+                                      "torch.FloatStorage"),
+               np.dtype(np.float64): ("torch.DoubleTensor",
+                                      "torch.DoubleStorage"),
+               np.dtype(np.int64): ("torch.LongTensor",
+                                    "torch.LongStorage")}[arr.dtype]
+        self.put("i", TYPE_TORCH)
+        idx, seen = self._next_index(arr)
+        self.put("i", idx)
+        if seen:
+            return
+        self.write_string("V 1")
+        self.write_string(cls[0])
+        self.put("i", arr.ndim)
+        for s in arr.shape:
+            self.put("q", s)
+        stride = 1
+        strides = []
+        for s in reversed(arr.shape):
+            strides.append(stride)
+            stride *= s
+        for s in reversed(strides):
+            self.put("q", s)
+        self.put("q", 1)                   # storageOffset, 1-based
+        # storage object
+        self.put("i", TYPE_TORCH)
+        self.index += 1
+        self.put("i", self.index)
+        self.write_string("V 1")
+        self.write_string(cls[1])
+        self.put("q", arr.size)
+        self.parts.append(arr.tobytes())
+
+    def write_table(self, table: dict):
+        self.put("i", TYPE_TABLE)
+        idx, seen = self._next_index(table)
+        self.put("i", idx)
+        if seen:
+            return
+        self.put("i", len(table))
+        for k, v in table.items():
+            self.write_object(float(k) if isinstance(k, int) else k)
+            self.write_object(v)
+
+    def write_module(self, module):
+        self.put("i", TYPE_TORCH)
+        idx, seen = self._next_index(module)
+        self.put("i", idx)
+        if seen:
+            return
+        cls, table = _module_to_table(module)
+        self.write_string("V 1")
+        self.write_string(cls)
+        self.write_table(table)
+
+    def write_object(self, obj):
+        if obj is None:
+            self.put("i", TYPE_NIL)
+        elif isinstance(obj, bool):
+            self.put("i", TYPE_BOOLEAN)
+            self.put("i", 1 if obj else 0)
+        elif isinstance(obj, (int, float)):
+            self.put("i", TYPE_NUMBER)
+            self.put("d", float(obj))
+        elif isinstance(obj, str):
+            self.put("i", TYPE_STRING)
+            self.write_string(obj)
+        elif isinstance(obj, np.ndarray) or hasattr(obj, "__array__"):
+            self.write_tensor(np.asarray(obj))
+        elif isinstance(obj, dict):
+            self.write_table(obj)
+        else:
+            self.write_module(obj)
+
+
+def _np(x):
+    return None if x is None else np.asarray(x)
+
+
+def _module_to_table(m) -> tuple[str, dict]:
+    """bigdl_tpu module -> (torch class name, field table) (reference
+    write<Module> family, TorchFile.scala:443-620)."""
+    from bigdl_tpu import nn
+    t: dict = {"_type": "torch.FloatTensor", "train": m.is_training()}
+    p = m.params or {}
+    if isinstance(m, (nn.Sequential, nn.Concat, nn.ConcatTable)):
+        mods = {i + 1: child for i, child in enumerate(m.modules)}
+        t["modules"] = mods
+        if isinstance(m, nn.Concat):
+            t["dimension"] = m.dimension + 1   # torch is 1-based
+            return "nn.Concat", t
+        if isinstance(m, nn.ConcatTable):
+            return "nn.ConcatTable", t
+        return "nn.Sequential", t
+    m.materialize()
+    p = m.params
+    if isinstance(m, nn.SpatialConvolution):
+        t.update(nInputPlane=float(m.n_input_plane),
+                 nOutputPlane=float(m.n_output_plane),
+                 kW=float(m.kw), kH=float(m.kh), dW=float(m.dw),
+                 dH=float(m.dh), padW=float(m.pw), padH=float(m.ph),
+                 weight=_np(p["weight"]),
+                 gradWeight=np.zeros_like(_np(p["weight"])))
+        if "bias" in p:
+            t["bias"] = _np(p["bias"])
+            t["gradBias"] = np.zeros_like(t["bias"])
+        return "nn.SpatialConvolution", t
+    if isinstance(m, nn.Linear):
+        t.update(weight=_np(p["weight"]),
+                 gradWeight=np.zeros_like(_np(p["weight"])))
+        if "bias" in p:
+            t["bias"] = _np(p["bias"])
+            t["gradBias"] = np.zeros_like(t["bias"])
+        return "nn.Linear", t
+    if isinstance(m, nn.SpatialMaxPooling):
+        t.update(kW=float(m.kw), kH=float(m.kh), dW=float(m.dw),
+                 dH=float(m.dh), padW=float(m.pw), padH=float(m.ph),
+                 ceil_mode=bool(getattr(m, "ceil_mode", False)))
+        return "nn.SpatialMaxPooling", t
+    if isinstance(m, nn.SpatialAveragePooling):
+        t.update(kW=float(m.kw), kH=float(m.kh), dW=float(m.dw),
+                 dH=float(m.dh), padW=float(m.pw), padH=float(m.ph),
+                 ceil_mode=False)
+        return "nn.SpatialAveragePooling", t
+    if isinstance(m, nn.BatchNormalization):   # covers Spatial variant
+        t.update(eps=float(m.eps), momentum=float(m.momentum),
+                 affine=bool(m.affine),
+                 running_mean=_np(m.state["running_mean"]),
+                 running_var=_np(m.state["running_var"]))
+        if m.affine:
+            t["weight"] = _np(p["weight"])
+            t["bias"] = _np(p["bias"])
+        cls = ("nn.SpatialBatchNormalization"
+               if isinstance(m, nn.SpatialBatchNormalization)
+               else "nn.BatchNormalization")
+        return cls, t
+    if isinstance(m, nn.ReLU):
+        t.update(inplace=False, val=0.0, threshold=0.0)
+        return "nn.ReLU", t
+    if isinstance(m, nn.Tanh):
+        return "nn.Tanh", t
+    if isinstance(m, nn.Sigmoid):
+        return "nn.Sigmoid", t
+    if isinstance(m, nn.LogSoftMax):
+        return "nn.LogSoftMax", t
+    if isinstance(m, nn.View):
+        t["size"] = np.asarray(m.sizes, np.int64)
+        t["numElements"] = float(int(np.prod(
+            [s for s in m.sizes if s > 0])))
+        return "nn.View", t
+    if isinstance(m, nn.Reshape):
+        t["size"] = np.asarray(m.size, np.int64)
+        return "nn.Reshape", t
+    if isinstance(m, nn.Dropout):
+        t["p"] = float(m.p)
+        t["noise"] = np.zeros((0,), np.float32)
+        return "nn.Dropout", t
+    if isinstance(m, nn.Identity):
+        return "nn.Identity", t
+    raise ValueError(f"saveTorch: unsupported module {type(m).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def load(path: str, build_modules: bool = True):
+    """Read a .t7 file (reference TorchFile.load, :72-78). Tensors come
+    back as numpy arrays, tables as TorchTable, nn classes as bigdl_tpu
+    modules (or raw field tables when ``build_modules=False``)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    return _Reader(buf, build_modules).read_object()
+
+
+def save(obj, path: str, overwrite: bool = False):
+    """Write tensors/tables/modules as .t7 (reference TorchFile.save)."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    w = _Writer()
+    w.write_object(obj)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(b"".join(w.parts))
+    os.replace(tmp, path)
+
+
+def load_torch(path: str):
+    """(reference Module.loadTorch, nn/Module.scala:31-33)"""
+    module = load(path, build_modules=True)
+    if not hasattr(module, "apply"):
+        raise ValueError(f"{path} does not contain an nn module")
+    return module
+
+
+def save_torch(module, path: str, overwrite: bool = False):
+    """(reference AbstractModule.saveTorch, :311-315)"""
+    save(module, path, overwrite)

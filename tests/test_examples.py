@@ -41,3 +41,31 @@ class TestModelValidator:
         from bigdl_tpu.examples.loadmodel import model_validator
         with pytest.raises(ValueError, match="torch, caffe or bigdl"):
             model_validator.main(["-m", "resnet", "-t", "mxnet"])
+
+
+class TestImagePredictor:
+    def test_predict_folder_end_to_end(self, tmp_path):
+        """Reference ImagePredictor flow: folder of unlabeled images ->
+        preprocess -> predict_class -> (name, class) pairs."""
+        from PIL import Image
+        from bigdl_tpu.examples.imageclassification import image_predictor
+        rng = np.random.default_rng(1)
+        img_dir = tmp_path / "imgs"
+        img_dir.mkdir()
+        for i in range(5):
+            arr = rng.integers(0, 256, (260, 280, 3), np.uint8)
+            Image.fromarray(arr).save(img_dir / f"photo_{i}.jpg")
+        model = (nn.Sequential()
+                 .add(nn.SpatialAveragePooling(224, 224, 224, 224))
+                 .add(nn.View(3))
+                 .add(nn.Linear(3, 4))
+                 .add(nn.LogSoftMax()))
+        model.materialize()
+        mpath = tmp_path / "model.bigdl"
+        model.save(str(mpath))
+        results = image_predictor.main([
+            "-f", str(img_dir), "--modelPath", str(mpath), "-b", "2"])
+        assert len(results) == 5
+        names = [n for n, _ in results]
+        assert names == sorted(names)
+        assert all(1 <= c <= 4 for _, c in results)

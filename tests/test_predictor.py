@@ -1,0 +1,137 @@
+"""Predictor + DistriValidator + Test-main tests (reference
+ml/DLClassifier.scala:36-138, optim/DistriValidator.scala:29-80,
+models/*/Test.scala)."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample, array, SampleToBatch
+from bigdl_tpu.parallel import Engine, get_mesh
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def make_model():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3),
+                      nn.LogSoftMax())
+    m.materialize()
+    return m
+
+
+class TestPredictor:
+    def test_predict_ndarray_source(self):
+        m = make_model()
+        x = np.random.default_rng(0).random((10, 4), np.float32)
+        p = optim.Predictor(m, batch_size=4)
+        out = p.predict(x)
+        assert out.shape == (10, 3)
+        cls = p.predict_class(x)
+        assert cls.shape == (10,) and cls.min() >= 1 and cls.max() <= 3
+
+    def test_predict_matches_forward(self):
+        m = make_model()
+        x = np.random.default_rng(1).random((6, 4), np.float32)
+        p = optim.Predictor(m, batch_size=4)
+        np.testing.assert_allclose(np.asarray(p.predict(x)),
+                                   np.asarray(m.forward(x)), rtol=1e-5)
+
+    def test_predict_sample_iterable_and_dataset(self):
+        m = make_model()
+        x = np.random.default_rng(2).random((7, 4), np.float32)
+        samples = [Sample(x[i], 1.0) for i in range(7)]
+        p = optim.Predictor(m, batch_size=3)
+        out_iter = p.predict(iter(samples))
+        ds = array(samples) >> SampleToBatch(3)
+        out_ds = p.predict(ds)
+        np.testing.assert_allclose(out_iter, out_ds, rtol=1e-5)
+        assert out_iter.shape == (7, 3)
+
+    def test_predict_on_mesh_pads_and_trims(self):
+        Engine.init()
+        m = make_model()
+        x = np.random.default_rng(3).random((11, 4), np.float32)  # 11 % 8 != 0
+        p = optim.Predictor(m, batch_size=16, mesh=get_mesh())
+        out = p.predict(x)
+        assert out.shape == (11, 3)
+        p_local = optim.Predictor(m, batch_size=16)
+        np.testing.assert_allclose(out, p_local.predict(x), rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestDistriValidator:
+    def test_matches_local_validator(self):
+        Engine.init()
+        m = make_model()
+        rs = np.random.RandomState(4)
+        x = rs.rand(50, 4).astype(np.float32)
+        y = rs.randint(1, 4, 50).astype(np.float32)
+        ds = array([Sample(x[i], y[i]) for i in range(50)]) \
+            >> SampleToBatch(12)   # remainder batches, not mesh-divisible
+        local = optim.LocalValidator(m, ds).test(
+            [optim.Top1Accuracy(), optim.Loss(nn.ClassNLLCriterion())])
+        dist = optim.DistriValidator(m, ds).test(
+            [optim.Top1Accuracy(), optim.Loss(nn.ClassNLLCriterion())])
+        for (lr, _), (dr, _) in zip(local, dist):
+            np.testing.assert_allclose(lr.result()[0], dr.result()[0],
+                                       rtol=1e-5)
+            assert lr.result()[1] == dr.result()[1]
+
+    def test_factory_dispatch(self):
+        Engine.init()
+        m = make_model()
+        sharded = array([Sample(np.zeros(4, np.float32), 1.0)] * 16,
+                        num_shards=1) >> SampleToBatch(8)
+        v = optim.Validator(m, sharded)
+        assert isinstance(v, optim.DistriValidator)
+        local = array([Sample(np.zeros(4, np.float32), 1.0)] * 16) \
+            >> SampleToBatch(8)
+        assert isinstance(optim.Validator(m, local), optim.LocalValidator)
+
+
+class TestTestMains:
+    def test_vgg_test_main(self, tmp_path):
+        """End-to-end: save a model, evaluate it via the vgg Test CLI over
+        a synthetic CIFAR binary folder."""
+        rng = np.random.default_rng(0)
+        recs = []
+        for i in range(16):
+            rec = np.zeros(3073, np.uint8)
+            rec[0] = i % 10
+            rec[1:] = rng.integers(0, 256, 3072, np.uint8)
+            recs.append(rec)
+        (tmp_path / "test_batch.bin").write_bytes(
+            np.concatenate(recs).tobytes())
+        from bigdl_tpu.models import VggForCifar10
+        model = VggForCifar10(class_num=10)
+        model.materialize()
+        model.save(str(tmp_path / "m.bigdl"))
+        from bigdl_tpu.models.vgg import test as vggtest
+        results = vggtest.main(["-f", str(tmp_path), "--model",
+                                str(tmp_path / "m.bigdl"), "-b", "8"])
+        acc, n = results[0][0].result()
+        assert n == 16 and 0.0 <= acc <= 1.0
+
+    def test_rnn_generation_main(self, tmp_path):
+        from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+        toks = list(SentenceTokenizer()(iter(["the cat sat on the mat",
+                                              "the dog sat"])))
+        d = Dictionary(toks, vocab_size=8)
+        d.save(str(tmp_path))
+        (tmp_path / "test.txt").write_text("the cat. the dog.")
+        from bigdl_tpu.models import BatchedSimpleRNN
+        vocab = d.get_vocab_size() + 1
+        model = BatchedSimpleRNN(vocab, 8, vocab)
+        model.materialize()
+        model.save(str(tmp_path / "m.bigdl"))
+        from bigdl_tpu.models.rnn import test as rnntest
+        results = rnntest.main(["-f", str(tmp_path), "--model",
+                                str(tmp_path / "m.bigdl"),
+                                "--numOfWords", "3"])
+        assert len(results) == 2
+        assert all(len(words) >= 5 for words in results)  # seed + 3 words

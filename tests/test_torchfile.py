@@ -1,0 +1,119 @@
+"""TorchFile (.t7) tests (reference utils/TorchFile.scala:35-1047).
+
+``tests/resources/torch_tensor.t7`` is a genuine lua-torch-written tensor
+fixture (from the reference's test resources) — loading it validates
+byte-level compatibility with real Torch output.
+"""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import torchfile
+
+RES = Path(__file__).parent / "resources"
+
+
+class TestRealTorchFixture:
+    @pytest.mark.skipif(not (RES / "torch_tensor.t7").exists(),
+                        reason="fixture missing")
+    def test_load_lua_torch_tensor(self):
+        t = torchfile.load(str(RES / "torch_tensor.t7"))
+        assert isinstance(t, np.ndarray)
+        assert t.ndim == 3 and t.shape[0] == 3     # a CHW image tensor
+        assert np.isfinite(t).all()
+
+
+class TestPrimitivesRoundTrip:
+    def test_scalar_table_string_bool(self, tmp_path):
+        obj = {"lr": 0.1, "name": "sgd", "nesterov": True, "nil": None,
+               1: 11.0, 2: 22.0}
+        p = tmp_path / "t.t7"
+        torchfile.save(obj, str(p))
+        back = torchfile.load(str(p))
+        assert back["lr"] == 0.1 and back["name"] == "sgd"
+        assert back["nesterov"] is True and back["nil"] is None
+        assert back.array() == [11.0, 22.0]
+
+    def test_tensor_roundtrip_dtypes(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for arr in [rng.random((3, 4, 5)).astype(np.float32),
+                    rng.random((7,)).astype(np.float64),
+                    rng.integers(0, 9, (2, 3)).astype(np.int64)]:
+            p = tmp_path / "x.t7"
+            torchfile.save(arr, str(p), overwrite=True)
+            back = torchfile.load(str(p))
+            np.testing.assert_array_equal(back, arr)
+            assert back.dtype == arr.dtype
+
+    def test_overwrite_guard(self, tmp_path):
+        p = tmp_path / "x.t7"
+        torchfile.save(1.0, str(p))
+        with pytest.raises(FileExistsError):
+            torchfile.save(2.0, str(p))
+
+
+class TestModuleRoundTrip:
+    def test_lenet_like_roundtrip_forward_parity(self, tmp_path):
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(1, 6, 5, 5))
+                 .add(nn.Tanh())
+                 .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+                 .add(nn.SpatialConvolution(6, 12, 5, 5))
+                 .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+                 .add(nn.Reshape((12 * 4 * 4,)))
+                 .add(nn.Linear(12 * 4 * 4, 10))
+                 .add(nn.LogSoftMax()))
+        model.materialize()
+        p = tmp_path / "lenet.t7"
+        torchfile.save_torch(model, str(p))
+        loaded = torchfile.load_torch(str(p))
+        x = np.random.default_rng(1).random((2, 1, 28, 28), np.float32)
+        np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                                   np.asarray(model.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_batchnorm_state_roundtrip(self, tmp_path):
+        bn = nn.SpatialBatchNormalization(4)
+        bn.materialize()
+        import jax.numpy as jnp
+        bn.state["running_mean"] = jnp.asarray([1., 2., 3., 4.])
+        bn.state["running_var"] = jnp.asarray([4., 3., 2., 1.])
+        p = tmp_path / "bn.t7"
+        torchfile.save_torch(bn, str(p))
+        back = torchfile.load_torch(str(p))
+        np.testing.assert_allclose(np.asarray(back.state["running_mean"]),
+                                   [1, 2, 3, 4])
+        np.testing.assert_allclose(np.asarray(back.state["running_var"]),
+                                   [4, 3, 2, 1])
+        assert back.eps == bn.eps and back.momentum == bn.momentum
+
+    def test_concat_and_dropout(self, tmp_path):
+        model = (nn.Sequential()
+                 .add(nn.Concat(1)
+                      .add(nn.SpatialConvolution(2, 3, 1, 1))
+                      .add(nn.SpatialConvolution(2, 5, 1, 1)))
+                 .add(nn.Dropout(0.3)))
+        model.materialize()
+        p = tmp_path / "c.t7"
+        torchfile.save_torch(model, str(p))
+        back = torchfile.load_torch(str(p))
+        assert isinstance(back[0], nn.Concat) and back[0].dimension == 1
+        assert isinstance(back[1], nn.Dropout) and back[1].p == 0.3
+        x = np.random.default_rng(2).random((2, 2, 4, 4), np.float32)
+        back.evaluate()
+        model.evaluate()
+        np.testing.assert_allclose(np.asarray(back.forward(x)),
+                                   np.asarray(model.forward(x)), rtol=1e-5)
+
+    def test_shared_object_backreference(self, tmp_path):
+        """The registry must deduplicate shared tensors (Torch files use
+        back-references; TorchFile.scala:213-249)."""
+        w = np.ones((2, 2), np.float32)
+        obj = {"a": w, "b": w}
+        p = tmp_path / "s.t7"
+        torchfile.save(obj, str(p))
+        back = torchfile.load(str(p))
+        np.testing.assert_array_equal(back["a"], back["b"])
+        assert back["a"] is back["b"]   # same registry object
