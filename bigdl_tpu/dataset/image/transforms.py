@@ -391,9 +391,23 @@ class MTImgToBatch(Transformer):
 
     def __call__(self, it):
         out_q: "queue.Queue" = queue.Queue(maxsize=max(1, self.prefetch))
+        # bounded: backpressure must reach the decode workers, or with an
+        # endless source they decode ahead without limit
+        claim_q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, self.prefetch) + self.num_threads)
         stop = object()
+        shutdown = threading.Event()
         invocation = self._invocation
         self._invocation += 1
+
+        def safe_put(q, item) -> bool:
+            while not shutdown.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
@@ -410,6 +424,8 @@ class MTImgToBatch(Transformer):
                         chunk = []
                         try:
                             for _ in range(self.batch_size):
+                                if shutdown.is_set():
+                                    break
                                 chunk.append(next(it))
                         except StopIteration:
                             pass
@@ -418,16 +434,15 @@ class MTImgToBatch(Transformer):
                             seq_counter[0] += 1
                         return seq, chunk
 
-                claim_q: "queue.Queue" = queue.Queue()
-
                 def worker(widx, w):
                     RandomGenerator.seed_worker(widx, invocation)
-                    while True:
+                    while not shutdown.is_set():
                         seq, chunk = pull_chunk()
                         if not chunk:
-                            claim_q.put((None, stop))
+                            break
+                        if not safe_put(claim_q, (seq, list(w(iter(chunk))))):
                             return
-                        claim_q.put((seq, list(w(iter(chunk)))))
+                    safe_put(claim_q, (None, stop))
 
                 threads = [threading.Thread(target=worker, args=(i, w),
                                             daemon=True)
@@ -439,27 +454,46 @@ class MTImgToBatch(Transformer):
                 pending: dict = {}
                 next_seq = 0
                 finished = 0
-                while finished < self.num_threads:
-                    seq, got = claim_q.get()
+                while finished < self.num_threads and not shutdown.is_set():
+                    try:
+                        seq, got = claim_q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                     if got is stop:
                         finished += 1
                         continue
                     pending[seq] = got
                     while next_seq in pending:
-                        out_q.put(self._assemble(pending.pop(next_seq)))
+                        if not safe_put(
+                                out_q,
+                                self._assemble(pending.pop(next_seq))):
+                            return
                         next_seq += 1
                 # seqs are claimed contiguously and every claimed chunk is
                 # enqueued before its worker's stop marker, so the in-order
-                # drain above must have emptied pending
-                assert not pending, f"unflushed chunks: {sorted(pending)}"
-                for t in threads:
-                    t.join()
+                # drain above must have emptied pending on a clean finish
+                assert shutdown.is_set() or not pending, \
+                    f"unflushed chunks: {sorted(pending)}"
             finally:
-                out_q.put(stop)
+                shutdown.set()   # unblock any worker stuck on claim_q
+                try:
+                    out_q.put_nowait(stop)
+                except queue.Full:
+                    pass
 
         threading.Thread(target=producer, daemon=True).start()
-        while True:
-            batch = out_q.get()
-            if batch is stop:
-                return
-            yield batch
+        try:
+            while True:
+                try:
+                    batch = out_q.get(timeout=0.1)
+                except queue.Empty:
+                    if shutdown.is_set():
+                        return
+                    continue
+                if batch is stop:
+                    return
+                yield batch
+        finally:
+            # consumer abandoned the iterator (epoch rollover over an
+            # endless source): wind every thread down
+            shutdown.set()

@@ -1,0 +1,56 @@
+"""ImageNet shard generator CLI (reference
+models/utils/ImageNetSeqFileGenerator.scala — folder -> N record shards of
+resized JPEG bytes + labels).
+
+Run::
+
+    python -m bigdl_tpu.models.utils.imagenet_gen \
+        -f <imagenet_root> -o <output_dir> -p 8 [--scaleTo 256]
+
+``<imagenet_root>`` holds ``train/`` and/or ``val/`` class-per-subfolder
+trees (or is itself one tree).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+logger = logging.getLogger("bigdl_tpu.models.utils.imagenet_gen")
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("ImageNet record-shard generator")
+    p.add_argument("-f", "--folder", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-p", "--parallel", type=int, default=8,
+                   help="number of shard files per split")
+    p.add_argument("--scaleTo", type=int, default=256,
+                   help="shorter-side resize before writing (0 = raw copy)")
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.dataset.recordio import generate_shards
+
+    scale = args.scaleTo or None
+    written = {}
+    for split in ("train", "val"):
+        src = os.path.join(args.folder, split)
+        if os.path.isdir(src):
+            out = os.path.join(args.output, split)
+            paths = generate_shards(src, out, args.parallel,
+                                    shuffle=split == "train",
+                                    scale_to=scale)
+            written[split] = paths
+            logger.info("%s: wrote %d shards under %s", split, len(paths),
+                        out)
+    if not written:   # the folder itself is a class tree
+        paths = generate_shards(args.folder, args.output, args.parallel,
+                                scale_to=scale)
+        written["train"] = paths
+        logger.info("wrote %d shards under %s", len(paths), args.output)
+    return written
+
+
+if __name__ == "__main__":
+    main()

@@ -146,14 +146,22 @@ class DistriOptimizer(Optimizer):
             self._profile_hook(driver_state["neval"])
             t0 = time.perf_counter()
             batch = next(data_iter)
-            data, labels = np.asarray(batch.data), np.asarray(batch.labels)
-            global_n = data.shape[0] * jax.process_count()
+            if isinstance(batch.data, jax.Array):
+                # DevicePrefetcher already placed the batch (overlapped
+                # with the previous device step) — don't round-trip it
+                data, labels = batch.data, batch.labels
+                global_n = data.shape[0]
+            else:
+                data = np.asarray(batch.data)
+                labels = np.asarray(batch.labels)
+                global_n = data.shape[0] * jax.process_count()
             if global_n % n_shards != 0:
                 raise ValueError(
                     f"global batch {global_n} not divisible by "
                     f"{n_shards} mesh devices (reference Utils.getBatchSize "
                     "divisibility requirement, dataset/Utils.scala:25-47)")
-            data, labels = self._shard_batch(data, labels, batch_shard)
+            if not isinstance(data, jax.Array):
+                data, labels = self._shard_batch(data, labels, batch_shard)
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)

@@ -1,0 +1,78 @@
+"""Worker script for the 2-process multi-host test (NOT a pytest module).
+
+Each process owns 4 virtual CPU devices and one data shard; DistriOptimizer
+assembles global batches via jax.make_array_from_process_local_data and
+trains in lockstep over the 8-device global mesh — the DCN code path
+(distri_optimizer._shard_batch multi-process branch).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+Prints one line: ``LOSSES <pid> <json list>``.
+"""
+import json
+import logging
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=nproc, process_id=pid)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import Sample, SampleToBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.parallel import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(9)
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+
+    sharded = ShardedDataSet(samples, num_shards=nproc,
+                             shard_index=jax.process_index())
+    # pin the per-pass rotation so the global sample set per step matches
+    # the single-process control exactly
+    sharded._pass_offset = lambda k: 0
+    # global batch 16 -> 4 batches/epoch: all compared iterations stay in
+    # epoch 1 (epoch-end shuffles are per-shard, like the reference's
+    # per-partition shuffle, so they can't match a single-process control)
+    ds = sharded >> SampleToBatch(16 // nproc, drop_remainder=True)
+
+    losses = []
+
+    class Rec(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if "loss is" in msg:
+                losses.append(float(msg.split("loss is ")[1].split(",")[0]))
+
+    logger = logging.getLogger("bigdl_tpu.optim")
+    logger.addHandler(Rec())
+    logger.setLevel(logging.INFO)
+
+    model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    Engine.reset()
+    mesh = Engine.init()          # all 8 global devices
+    o = optim.Optimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion(), mesh=mesh)
+    o.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+    o.set_end_when(optim.max_iteration(4))
+    o.optimize()
+    print(f"LOSSES {pid} {json.dumps(losses)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

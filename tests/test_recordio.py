@@ -1,0 +1,172 @@
+"""Record-shard pipeline tests (reference DataSet.SeqFileFolder,
+dataset/DataSet.scala:383-454 + ImageNetSeqFileGenerator)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import recordio
+from bigdl_tpu.dataset.recordio import (DevicePrefetcher, RecordShardDataSet,
+                                        RecordWriter, generate_shards,
+                                        read_records)
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+def _image_tree(root, classes=("cat", "dog"), n=6, size=64):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in classes:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(n):
+            arr = rng.integers(0, 256, (size + 8, size, 3), np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+
+
+class TestRecordFormat:
+    def test_write_read_roundtrip(self, tmp_path):
+        p = tmp_path / f"s{recordio.SHARD_SUFFIX}"
+        with RecordWriter(str(p)) as w:
+            w.write(b"hello", 1.0)
+            w.write(b"\x00\xff" * 100, 7.0)
+        recs = list(read_records(str(p)))
+        assert [(r.data, r.label) for r in recs] == \
+            [(b"hello", 1.0), (b"\x00\xff" * 100, 7.0)]
+        assert recordio.shard_count(str(p)) == 2
+
+    def test_skip(self, tmp_path):
+        p = tmp_path / f"s{recordio.SHARD_SUFFIX}"
+        with RecordWriter(str(p)) as w:
+            for i in range(5):
+                w.write(bytes([i]), float(i))
+        recs = list(read_records(str(p), skip=3))
+        assert [r.label for r in recs] == [3.0, 4.0]
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "junk.brec"
+        p.write_bytes(b"NOPE")
+        with pytest.raises(ValueError, match="not a record shard"):
+            list(read_records(str(p)))
+
+
+class TestGenerator:
+    def test_generate_and_read_back(self, tmp_path):
+        _image_tree(tmp_path / "imgs")
+        out = tmp_path / "shards"
+        paths = generate_shards(str(tmp_path / "imgs"), str(out),
+                                num_shards=3, scale_to=32)
+        assert len(paths) == 3
+        ds = RecordShardDataSet(str(out))
+        assert ds.size() == 12
+        recs = list(ds.data(train=False))
+        assert len(recs) == 12
+        assert sorted({r.label for r in recs}) == [1.0, 2.0]
+        # records decode as scaled JPEG
+        from bigdl_tpu.dataset.image import BytesToBGRImg
+        img = next(iter(BytesToBGRImg()(iter(recs))))
+        assert min(img.content.shape[:2]) == 32
+
+    def test_process_sharding(self, tmp_path):
+        _image_tree(tmp_path / "imgs")
+        out = tmp_path / "shards"
+        generate_shards(str(tmp_path / "imgs"), str(out), num_shards=4,
+                        scale_to=32)
+        d0 = RecordShardDataSet(str(out), process_index=0, process_count=2)
+        d1 = RecordShardDataSet(str(out), process_index=1, process_count=2)
+        assert d0.local_size() + d1.local_size() == 12
+        assert d0.size() == d1.size() == 12
+        with pytest.raises(ValueError, match="no shards"):
+            RecordShardDataSet(str(out), process_index=4, process_count=8)
+
+
+class TestEndToEndTraining:
+    def test_inception_style_pipeline_trains(self, tmp_path):
+        """Shard files -> decode threads -> batches -> one optimizer run
+        (the flagship config's input path, small scale)."""
+        _image_tree(tmp_path / "imgs", n=8, size=40)
+        out = tmp_path / "shards"
+        generate_shards(str(tmp_path / "imgs"), str(out), num_shards=2,
+                        scale_to=36)
+        from bigdl_tpu import nn, optim
+        from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                             BytesToBGRImg, CropRandom,
+                                             MTImgToBatch)
+        RandomGenerator.set_seed(4)
+        inner = (BytesToBGRImg()
+                 >> BGRImgCropper(32, 32, CropRandom)
+                 >> BGRImgNormalizer(0.45, 0.45, 0.45, 0.25, 0.25, 0.25))
+        ds = RecordShardDataSet(str(out)) >> MTImgToBatch(8, inner,
+                                                          num_threads=2)
+        model = nn.Sequential(nn.View(3 * 32 * 32), nn.Linear(3 * 32 * 32, 2),
+                              nn.LogSoftMax())
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.05))
+        o.set_end_when(optim.max_iteration(6))
+        trained = o.optimize()
+        assert trained is model
+        s = o.metrics.stats("device step time")
+        assert s["n"] == 6
+
+    def test_prefetched_batches_feed_distri_optimizer(self, tmp_path):
+        """DevicePrefetcher output (already-placed jax.Arrays) must flow
+        through DistriOptimizer without a host round-trip."""
+        import jax
+        from bigdl_tpu import nn, optim
+        from bigdl_tpu.dataset import Sample, array, SampleToBatch
+        from bigdl_tpu.parallel import Engine, data_sharding
+
+        Engine.reset()
+        mesh = Engine.init()
+        try:
+            rs = np.random.RandomState(1)
+            x = rs.rand(64, 4).astype(np.float32)
+            y = rs.randint(1, 3, 64)
+            ds = (array([Sample(x[i], float(y[i])) for i in range(64)])
+                  >> SampleToBatch(16, drop_remainder=True)
+                  >> DevicePrefetcher(data_sharding(mesh)))
+            model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+            o = optim.Optimizer(model=model, dataset=ds,
+                                criterion=nn.ClassNLLCriterion(), mesh=mesh)
+            o.set_end_when(optim.max_iteration(5))
+            trained = o.optimize()
+            assert trained is model
+            assert o.metrics.stats("device step time")["n"] == 5
+        finally:
+            Engine.reset()
+
+    def test_mt_pipeline_threads_wind_down_on_abandon(self, tmp_path):
+        """Epoch rollover abandons the training iterator mid-stream; the
+        MTImgToBatch workers must stop decoding (bounded claim queue +
+        shutdown event), not keep consuming the endless source."""
+        import threading
+        import time
+        from bigdl_tpu.dataset.image import (BGRImgNormalizer, LabeledBGRImage,
+                                             MTImgToBatch)
+        from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+
+        imgs = [LabeledBGRImage(np.zeros((8, 8, 3), np.float32),
+                                float(i % 2 + 1)) for i in range(32)]
+        ds = LocalArrayDataSet(imgs) >> MTImgToBatch(
+            4, BGRImgNormalizer(0, 0, 0, 1, 1, 1), num_threads=3,
+            prefetch=2)
+        before = threading.active_count()
+        it = ds.data(train=True)          # ENDLESS source
+        for _ in range(3):
+            next(it)
+        it.close()                        # abandon mid-stream
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            f"leaked threads: {threading.active_count() - before}"
+
+    def test_device_prefetcher_preserves_batches(self, tmp_path):
+        import jax
+        from bigdl_tpu.dataset.sample import MiniBatch
+        batches = [MiniBatch(np.full((4, 2), i, np.float32),
+                             np.full((4,), i, np.float32))
+                   for i in range(5)]
+        out = list(DevicePrefetcher(depth=2)(iter(batches)))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert isinstance(b.data, jax.Array)
+            np.testing.assert_array_equal(np.asarray(b.data), batches[i].data)
