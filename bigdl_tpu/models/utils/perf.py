@@ -45,7 +45,8 @@ def main(argv=None):
 
     if args.dataType == "bf16":
         set_policy(DTypePolicy(param_dtype=jnp.float32,
-                               compute_dtype=jnp.bfloat16))
+                               compute_dtype=jnp.bfloat16,
+                               activation_dtype=jnp.bfloat16))
 
     spec, size = MODELS[args.module]
     if callable(spec):
@@ -96,8 +97,14 @@ def main(argv=None):
               f"{time.perf_counter() - t1:.4f}s")
     float(loss)
     dt = time.perf_counter() - t0
-    print(f"{args.module}: {args.batchSize * args.iteration / dt:.2f} "
-          f"records/second ({dt / args.iteration * 1000:.2f} ms/iteration)")
+    line = (f"{args.module}: {args.batchSize * args.iteration / dt:.2f} "
+            f"records/second ({dt / args.iteration * 1000:.2f} ms/iteration)")
+    cost = jit_step.lower(params, mstate, opt_state, rng, data,
+                          labels).compile().cost_analysis()
+    if cost and cost.get("flops"):
+        tflops = cost["flops"] * args.iteration / dt / 1e12
+        line += f" [{tflops:.1f} TFLOP/s achieved]"
+    print(line)
 
 
 if __name__ == "__main__":

@@ -140,22 +140,30 @@ class LogSigmoid(_Elementwise):
 
 class SoftMax(_Elementwise):
     """Softmax over the feature axis (reference nn/SoftMax.scala, threaded;
-    last axis here)."""
+    last axis here). Exponent/sum in f32 regardless of activation dtype."""
 
     def fn(self, x):
-        return jax.nn.softmax(x, axis=-1)
+        f32 = jnp.promote_types(x.dtype, jnp.float32)
+        return jax.nn.softmax(x.astype(f32), axis=-1).astype(x.dtype)
 
 
 class SoftMin(_Elementwise):
     def fn(self, x):
-        return jax.nn.softmax(-x, axis=-1)
+        f32 = jnp.promote_types(x.dtype, jnp.float32)
+        return jax.nn.softmax(-x.astype(f32), axis=-1).astype(x.dtype)
 
 
 class LogSoftMax(_Elementwise):
-    """(reference nn/LogSoftMax.scala, threaded per-sample)"""
+    """(reference nn/LogSoftMax.scala, threaded per-sample).
+
+    Always computed and returned in f32: log-probabilities are the one
+    activation whose absolute accuracy feeds the loss directly, and the
+    tensor is tiny (N x classes).
+    """
 
     def fn(self, x):
-        return jax.nn.log_softmax(x, axis=-1)
+        return jax.nn.log_softmax(x.astype(
+            jnp.promote_types(x.dtype, jnp.float32)), axis=-1)
 
 
 class SoftPlus(_Elementwise):

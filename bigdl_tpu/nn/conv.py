@@ -18,7 +18,7 @@ import numpy as np
 
 from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import Module
-from bigdl_tpu.tensor import compute_dtype, default_dtype
+from bigdl_tpu.tensor import activation_dtype, compute_dtype, default_dtype
 
 __all__ = ["SpatialConvolution", "SpatialShareConvolution",
            "SpatialFullConvolution", "SpatialDilatedConvolution",
@@ -88,7 +88,7 @@ class SpatialConvolution(Module):
             feature_group_count=self.n_group)
         if self.with_bias:
             y = y + params["bias"].astype(compute_dtype())[None, :, None, None]
-        y = y.astype(params["weight"].dtype)
+        y = y.astype(activation_dtype())
         if squeeze:
             y = y[0]
         return y, state
@@ -129,7 +129,7 @@ class SpatialDilatedConvolution(SpatialConvolution):
             dimension_numbers=_DIMS)
         if self.with_bias:
             y = y + params["bias"].astype(compute_dtype())[None, :, None, None]
-        y = y.astype(params["weight"].dtype)
+        y = y.astype(activation_dtype())
         if squeeze:
             y = y[0]
         return y, state
@@ -198,7 +198,7 @@ class SpatialFullConvolution(Module):
             feature_group_count=self.n_group)
         if self.with_bias:
             y = y + params["bias"].astype(compute_dtype())[None, :, None, None]
-        y = y.astype(params["weight"].dtype)
+        y = y.astype(activation_dtype())
         if squeeze:
             y = y[0]
         return y, state
@@ -255,7 +255,7 @@ class SpatialConvolutionMap(Module):
             padding=[(self.ph, self.ph), (self.pw, self.pw)],
             dimension_numbers=_DIMS)
         y = y + params["bias"].astype(compute_dtype())[None, :, None, None]
-        y = y.astype(params["weight"].dtype)
+        y = y.astype(activation_dtype())
         if squeeze:
             y = y[0]
         return y, state

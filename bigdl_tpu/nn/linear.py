@@ -12,7 +12,7 @@ import numpy as np
 
 from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import Module
-from bigdl_tpu.tensor import compute_dtype, default_dtype
+from bigdl_tpu.tensor import activation_dtype, compute_dtype, default_dtype
 
 __all__ = ["Linear", "Bilinear", "LookupTable", "Cosine", "Euclidean",
            "Add", "CAdd", "CMul", "Mul", "MM", "MV"]
@@ -48,7 +48,7 @@ class Linear(Module):
         y = jnp.matmul(x.astype(compute_dtype()), w.T)
         if self.with_bias:
             y = y + params["bias"].astype(compute_dtype())
-        return y.astype(params["weight"].dtype), state
+        return y.astype(activation_dtype()), state
 
     def __repr__(self):
         return f"Linear({self.input_size} -> {self.output_size})"

@@ -55,7 +55,15 @@ class _Pool2d(Module):
 
 
 class SpatialMaxPooling(_Pool2d):
-    """(reference nn/SpatialMaxPooling.scala)"""
+    """(reference nn/SpatialMaxPooling.scala)
+
+    Backward is XLA's select-and-scatter via autodiff, which also matches
+    Torch's first-max tie rule. Hand-written VJPs for the stride-1 pools
+    (shifted equality sums, window argmax) were benchmarked in round 2 and
+    all measured SLOWER end-to-end than select-and-scatter once the Pallas
+    LRN kernel was in place (docs/PERF.md) — don't reintroduce one without
+    a fresh whole-model measurement.
+    """
 
     def apply(self, params, state, x, *, training=False, rng=None):
         squeeze = x.ndim == 3

@@ -1,0 +1,20 @@
+"""TPU-specific op implementations (Pallas kernels and shared numeric
+rewrites) used by the nn layer library where profiling justified them."""
+
+
+def pow_neg_beta(s, beta):
+    """s**(-beta) without transcendentals for the betas the model zoo uses.
+
+    ``pow`` lowers to exp/log on TPU; LRN's universal beta=0.75 is
+    rsqrt(s)*sqrt(rsqrt(s)) — pure VPU sqrt ops.
+    """
+    import jax
+    import jax.numpy as jnp
+    if beta == 0.75:
+        r = jax.lax.rsqrt(s)
+        return r * jnp.sqrt(r)
+    if beta == 0.5:
+        return jax.lax.rsqrt(s)
+    if beta == 1.0:
+        return 1.0 / s
+    return jnp.power(s, -beta)

