@@ -125,7 +125,52 @@ class LocalArrayDataSet(AbstractDataSet):
         self._index = np.asarray(state["index"]).copy()
 
 
-class ShardedDataSet(AbstractDataSet):
+class PassRotationMixin:
+    """Exact-resume machinery shared by the sharded datasets.
+
+    Requires ``self._index`` (np permutation of local items) and
+    ``self._seed_shard`` (this process's shard index). The per-pass start
+    offset is a pure function of (seed, shard, pass) — NOT a draw from the
+    shared host RNG stream — so a resumed run can replay the exact pass
+    the stopped run was in. One implementation so the checkpoint-replay
+    invariant cannot drift between in-memory and record-file datasets.
+    """
+
+    _pass_count = 0
+
+    def _pass_offset(self, k: int) -> int:
+        if len(self._index) == 0:
+            return 0
+        mix = (RandomGenerator._default_seed * 2654435761
+               + self._seed_shard * 40503 + k) % (2 ** 32)
+        g = np.random.Generator(np.random.MT19937(mix))
+        return int(g.integers(0, len(self._index)))
+
+    def _next_pass_order(self):
+        k = self._pass_count
+        self._pass_count = k + 1
+        return np.roll(self._index, -self._pass_offset(k))
+
+    def shuffle(self):
+        RandomGenerator.RNG().shuffle(self._index)
+
+    def get_position_state(self):
+        return {"index": self._index.copy(),
+                "passes_started": self._pass_count}
+
+    def set_position_state(self, state, mid_pass: bool = False):
+        # "order" is the key RecordShardDataSet checkpoints used before
+        # this machinery was unified; keep reading it so those resume
+        key = "index" if "index" in state else "order"
+        self._index = np.asarray(state[key]).copy()
+        passes = int(np.asarray(state.get("passes_started", 0)))
+        # mid_pass: the stopped run was inside pass k = passes-1; the fresh
+        # training iterator must replay that same pass (the optimizer then
+        # fast-forwards past the consumed batches)
+        self._pass_count = passes - 1 if (mid_pass and passes > 0) else passes
+
+
+class ShardedDataSet(PassRotationMixin, AbstractDataSet):
     """Data-parallel sharded dataset (replaces the reference's
     CachedDistriDataSet, DataSet.scala:163-259).
 
@@ -139,23 +184,12 @@ class ShardedDataSet(AbstractDataSet):
         self._all = list(data)
         self.num_shards = num_shards
         self.shard_index = shard_index
+        self._seed_shard = shard_index
         self._local = self._all[shard_index::num_shards]
         self._index = np.arange(len(self._local))
-        self._pass_count = 0
 
     def is_sharded(self):
         return True
-
-    def _pass_offset(self, k: int) -> int:
-        """Per-pass start offset, a pure function of (seed, shard, pass) —
-        NOT a draw from the shared host RNG stream, so a resumed run can
-        replay the exact pass the stopped run was in."""
-        if len(self._index) == 0:
-            return 0
-        mix = (RandomGenerator._default_seed * 2654435761
-               + self.shard_index * 40503 + k) % (2 ** 32)
-        g = np.random.Generator(np.random.MT19937(mix))
-        return int(g.integers(0, len(self._index)))
 
     def data(self, train: bool):
         if train:
@@ -165,11 +199,7 @@ class ShardedDataSet(AbstractDataSet):
                     "fewer samples than shards")
             def endless():
                 while True:
-                    k = self._pass_count
-                    self._pass_count = k + 1
-                    offset = self._pass_offset(k)
-                    order = np.roll(self._index, -offset)
-                    for i in order:
+                    for i in self._next_pass_order():
                         yield self._local[i]
             return endless()
         return iter([self._local[i] for i in self._index])
@@ -180,21 +210,6 @@ class ShardedDataSet(AbstractDataSet):
 
     def local_size(self) -> int:
         return len(self._local)
-
-    def shuffle(self):
-        RandomGenerator.RNG().shuffle(self._index)
-
-    def get_position_state(self):
-        return {"index": self._index.copy(),
-                "passes_started": self._pass_count}
-
-    def set_position_state(self, state, mid_pass: bool = False):
-        self._index = np.asarray(state["index"]).copy()
-        passes = int(np.asarray(state.get("passes_started", 0)))
-        # mid_pass: the stopped run was inside pass k = passes-1; the fresh
-        # training iterator must replay that same pass (the optimizer then
-        # fast-forwards past the consumed batches)
-        self._pass_count = passes - 1 if (mid_pass and passes > 0) else passes
 
 
 class _BatchIterable(AbstractDataSet):

@@ -397,6 +397,7 @@ class MTImgToBatch(Transformer):
             maxsize=max(1, self.prefetch) + self.num_threads)
         stop = object()
         shutdown = threading.Event()
+        errors: list = []  # first worker/producer exception, re-raised
         invocation = self._invocation
         self._invocation += 1
 
@@ -435,14 +436,23 @@ class MTImgToBatch(Transformer):
                         return seq, chunk
 
                 def worker(widx, w):
+                    # a decode/transform exception must not kill the thread
+                    # silently: record it and wake the pipeline, or the
+                    # dispatcher waits on finished<num_threads forever
                     RandomGenerator.seed_worker(widx, invocation)
-                    while not shutdown.is_set():
-                        seq, chunk = pull_chunk()
-                        if not chunk:
-                            break
-                        if not safe_put(claim_q, (seq, list(w(iter(chunk))))):
-                            return
-                    safe_put(claim_q, (None, stop))
+                    try:
+                        while not shutdown.is_set():
+                            seq, chunk = pull_chunk()
+                            if not chunk:
+                                break
+                            if not safe_put(claim_q,
+                                            (seq, list(w(iter(chunk))))):
+                                return
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        shutdown.set()
+                    finally:
+                        safe_put(claim_q, (None, stop))
 
                 threads = [threading.Thread(target=worker, args=(i, w),
                                             daemon=True)
@@ -474,6 +484,8 @@ class MTImgToBatch(Transformer):
                 # drain above must have emptied pending on a clean finish
                 assert shutdown.is_set() or not pending, \
                     f"unflushed chunks: {sorted(pending)}"
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
             finally:
                 shutdown.set()   # unblock any worker stuck on claim_q
                 try:
@@ -488,9 +500,13 @@ class MTImgToBatch(Transformer):
                     batch = out_q.get(timeout=0.1)
                 except queue.Empty:
                     if shutdown.is_set():
+                        if errors:
+                            raise errors[0]
                         return
                     continue
                 if batch is stop:
+                    if errors:
+                        raise errors[0]
                     return
                 yield batch
         finally:

@@ -29,7 +29,7 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
-from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.dataset import AbstractDataSet, PassRotationMixin
 from bigdl_tpu.dataset.sample import ByteRecord
 from bigdl_tpu.utils.random import RandomGenerator
 
@@ -65,30 +65,42 @@ class RecordWriter:
         self.close()
 
 
+def _headers(f, path):
+    """Yield (label, size) per record, leaving ``f`` at the payload start;
+    the caller must read or seek exactly ``size`` bytes before the next
+    iteration. The single home of the BTR1 framing logic."""
+    if f.read(4) != _MAGIC:
+        raise ValueError(f"{path} is not a record shard file")
+    while True:
+        head = f.read(12)
+        if len(head) < 12:
+            return
+        yield struct.unpack("<dI", head)
+
+
 def read_records(path: str, skip: int = 0) -> Iterator[ByteRecord]:
     """Stream ByteRecords from one shard file (optionally skipping the
     first ``skip`` records without decoding)."""
     with open(path, "rb") as f:
-        if f.read(4) != _MAGIC:
-            raise ValueError(f"{path} is not a record shard file")
-        n = 0
-        while True:
-            head = f.read(12)
-            if len(head) < 12:
-                return
-            label, size = struct.unpack("<dI", head)
+        for n, (label, size) in enumerate(_headers(f, path)):
             if n < skip:
                 f.seek(size, os.SEEK_CUR)
             else:
                 yield ByteRecord(f.read(size), label)
-            n += 1
 
 
 def shard_count(path: str) -> int:
     idx = Path(str(path) + ".idx")
     if idx.exists():
         return int(idx.read_text())
-    return sum(1 for _ in read_records(str(path)))
+    # sidecar missing: count by seeking over payloads — header reads only,
+    # never materializing record bytes
+    n = 0
+    with open(path, "rb") as f:
+        for _, size in _headers(f, path):
+            f.seek(size, os.SEEK_CUR)
+            n += 1
+    return n
 
 
 def _reencode(path: str, scale_to: int) -> bytes:
@@ -146,79 +158,77 @@ def generate_shards(image_folder: str, output_dir: str, num_shards: int = 8,
     return paths
 
 
-class RecordShardDataSet(AbstractDataSet):
+class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
     """Sharded dataset over record files (the SeqFileFolder role).
 
     ``process_index``/``process_count`` split the SHARD FILES across host
     processes (reference: RDD partitions pinned to executors); the training
     iterator loops endlessly over the local shards, rotating the shard
-    order per pass via the same pure pass-counter scheme as
-    ShardedDataSet so mid-epoch resume replays exactly.
+    order per pass via PassRotationMixin — the same pure pass-counter
+    scheme as ShardedDataSet, so mid-epoch resume replays exactly.
+
+    Record counts come from ``shards.json`` (written by generate_shards)
+    or the per-shard ``.idx`` sidecars, resolved lazily on first
+    ``size()``; only the headers are seeked if both are missing.
     """
 
     def __init__(self, folder_or_paths, process_index: int = 0,
                  process_count: int = 1):
+        self._meta_counts = None
         if isinstance(folder_or_paths, (str, Path)):
             self._all_paths = sorted(
                 str(p) for p in Path(folder_or_paths).iterdir()
                 if p.name.endswith(SHARD_SUFFIX))
+            meta = Path(folder_or_paths) / "shards.json"
+            if meta.exists():
+                m = json.loads(meta.read_text())
+                if len(m.get("counts", [])) == len(self._all_paths):
+                    # generate_shards writes counts in sorted-path order
+                    self._meta_counts = dict(zip(self._all_paths,
+                                                 m["counts"]))
         else:
             self._all_paths = [str(p) for p in folder_or_paths]
         if not self._all_paths:
             raise ValueError("no record shard files found")
         self.process_index = process_index
         self.process_count = process_count
+        self._seed_shard = process_index
         self._local = self._all_paths[process_index::process_count]
         if not self._local:
             raise ValueError(
                 f"process {process_index}/{process_count} got no shards — "
                 "fewer shard files than processes")
-        self._counts = {p: shard_count(p) for p in self._all_paths}
-        self._order = np.arange(len(self._local))
-        self._pass_count = 0
+        self._counts: dict = {}
+        self._index = np.arange(len(self._local))
+
+    def _count(self, path: str) -> int:
+        if path not in self._counts:
+            if self._meta_counts is not None:
+                self._counts[path] = self._meta_counts[path]
+            else:
+                self._counts[path] = shard_count(path)
+        return self._counts[path]
 
     def is_sharded(self):
         return self.process_count > 1
 
     def size(self) -> int:
         """Global record count (reference DistributedDataSet.size)."""
-        return sum(self._counts.values())
+        return sum(self._count(p) for p in self._all_paths)
 
     def local_size(self) -> int:
-        return sum(self._counts[p] for p in self._local)
-
-    def shuffle(self):
-        RandomGenerator.RNG().shuffle(self._order)
-
-    def get_position_state(self):
-        return {"order": self._order.copy(),
-                "passes_started": self._pass_count}
-
-    def set_position_state(self, state, mid_pass: bool = False):
-        self._order = np.asarray(state["order"]).copy()
-        passes = int(np.asarray(state.get("passes_started", 0)))
-        self._pass_count = passes - 1 if (mid_pass and passes > 0) else passes
-
-    def _pass_rotation(self, k: int) -> int:
-        mix = (RandomGenerator._default_seed * 2654435761
-               + self.process_index * 40503 + k) % (2 ** 32)
-        g = np.random.Generator(np.random.MT19937(mix))
-        return int(g.integers(0, max(len(self._local), 1)))
+        return sum(self._count(p) for p in self._local)
 
     def data(self, train: bool):
         if train:
             def endless():
                 while True:
-                    k = self._pass_count
-                    self._pass_count = k + 1
-                    rot = self._pass_rotation(k)
-                    order = np.roll(self._order, -rot)
-                    for i in order:
+                    for i in self._next_pass_order():
                         yield from read_records(self._local[int(i)])
             return endless()
 
         def single():
-            for i in self._order:
+            for i in self._index:
                 yield from read_records(self._local[int(i)])
         return single()
 
@@ -252,8 +262,20 @@ class DevicePrefetcher:
             return jax.device_put(arr, self.sharding)
 
         def put(b):
-            return MiniBatch(place(np.asarray(b.data)),
-                             place(np.asarray(b.labels)))
+            data = np.asarray(b.data)
+            if self.sharding is not None:
+                # raise the friendly misconfiguration error BEFORE
+                # device_put/make_array produce a low-level sharding error
+                # (the consumer's check can't fire: placement happens here)
+                n_dev = len(self.sharding.device_set)
+                global_n = data.shape[0] * (jax.process_count() if multi
+                                            else 1)
+                if global_n % n_dev != 0:
+                    raise ValueError(
+                        f"global batch {global_n} not divisible by {n_dev} "
+                        "mesh devices (reference Utils.getBatchSize "
+                        "divisibility requirement, dataset/Utils.scala:25-47)")
+            return MiniBatch(place(data), place(np.asarray(b.labels)))
 
         queue: deque = deque()
         for batch in it:

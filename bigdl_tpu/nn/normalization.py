@@ -107,14 +107,20 @@ class SpatialBatchNormalization(BatchNormalization):
     n_dim = 4
 
 
-def _lrn_window_sum(v, size):
-    """Sum over a size-wide window along the channel axis (NCHW axis 1)."""
+def _lrn_window_sum(v, size, adjoint=False):
+    """Sum over a size-wide window along the channel axis (NCHW axis 1).
+
+    ``adjoint`` transposes the (asymmetric, for even sizes) padding: the
+    forward window at j covers [j-half, j+size-1-half], so the backward
+    sum over {j : i in win(j)} covers [i-(size-1-half), i+half].
+    """
     half = (size - 1) // 2
+    lo, hi = (size - 1 - half, half) if adjoint else (half, size - 1 - half)
     return jax.lax.reduce_window(
         v, 0.0, jax.lax.add,
         window_dimensions=(1, size, 1, 1),
         window_strides=(1, 1, 1, 1),
-        padding=((0, 0), (half, size - 1 - half), (0, 0), (0, 0)))
+        padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
 
 
 def _lrn_impl(x, size, alpha, beta, k):
@@ -139,7 +145,7 @@ def _lrn_bwd(size, alpha, beta, k, res, g):
     x, sb, sb1 = res
     f32 = jnp.promote_types(x.dtype, jnp.float32)
     acc = _lrn_window_sum(g.astype(f32) * x.astype(f32) * sb1.astype(f32),
-                          size)
+                          size, adjoint=True)
     dx = g.astype(f32) * sb.astype(f32) \
         - (2.0 * alpha * beta / size) * x.astype(f32) * acc
     return (dx.astype(x.dtype),)

@@ -46,11 +46,16 @@ def lrn_supported(x) -> bool:
             and x.shape[1] % _sublane(x.dtype) == 0)
 
 
-def _window_sum(v, size):
-    """Sum over a size-wide window along axis 0 (channels, sublanes)."""
+def _window_sum(v, size, adjoint=False):
+    """Sum over a size-wide window along axis 0 (channels, sublanes).
+
+    ``adjoint`` transposes the (asymmetric, for even sizes) padding —
+    required for the backward sum over windows covering a position.
+    """
     half = (size - 1) // 2
+    lo, hi = (size - 1 - half, half) if adjoint else (half, size - 1 - half)
     c = v.shape[0]
-    p = jnp.pad(v, ((half, size - 1 - half), (0, 0)))
+    p = jnp.pad(v, ((lo, hi), (0, 0)))
     out = p[0:c]
     for d in range(1, size):
         out = out + p[d:d + c]
@@ -69,7 +74,7 @@ def _bwd_kernel(g_ref, x_ref, dx_ref, *, size, alpha, beta, k):
     x = x_ref[0].astype(jnp.float32)
     s = k + (alpha / size) * _window_sum(jnp.square(x), size)
     sb = _pow_neg_beta(s, beta)
-    acc = _window_sum(g * x * sb / s, size)
+    acc = _window_sum(g * x * sb / s, size, adjoint=True)
     dx = g * sb - (2.0 * alpha * beta / size) * x * acc
     dx_ref[0] = dx.astype(dx_ref.dtype)
 

@@ -148,6 +148,23 @@ class TestImageTransforms:
         flat = {tuple(b.data.reshape(-1)[:8]) for b in out}
         assert len(flat) > 1
 
+    def test_mt_batch_worker_exception_propagates(self):
+        """A decode/transform error in a worker must surface to the
+        consumer promptly — not hang the pipeline with a dead thread
+        (round-2 review finding: the stop marker was skipped on raise)."""
+        from bigdl_tpu.dataset.transformer import Transformer
+
+        class Poison(Transformer):
+            def __call__(self, it):
+                for img in it:
+                    if int(img.label) == 7:
+                        raise ValueError("corrupt record")
+                    yield img
+
+        imgs = bgr_images(n=12)
+        with pytest.raises(ValueError, match="corrupt record"):
+            list(MTImgToBatch(2, Poison(), num_threads=3)(iter(imgs)))
+
     def test_mt_batch_matches_serial(self):
         imgs = bgr_images(n=20)
         inner = BGRImgNormalizer(0.5, 0.5, 0.5, 1.0, 1.0, 1.0)

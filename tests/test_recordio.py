@@ -170,3 +170,54 @@ class TestEndToEndTraining:
         for i, b in enumerate(out):
             assert isinstance(b.data, jax.Array)
             np.testing.assert_array_equal(np.asarray(b.data), batches[i].data)
+
+    def test_device_prefetcher_rejects_indivisible_batch(self):
+        """The friendly divisibility error must come from the prefetcher —
+        placement happens here, before DistriOptimizer ever sees the batch
+        (round-2 review finding)."""
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.parallel import Engine, data_sharding
+        mesh = Engine.init()
+        bad = MiniBatch(np.zeros((7, 2), np.float32),
+                        np.zeros((7,), np.float32))
+        pf = DevicePrefetcher(data_sharding(mesh), depth=0)
+        with pytest.raises(ValueError, match="not divisible"):
+            list(pf(iter([bad])))
+
+
+class TestShardCounting:
+    def _make_shards(self, tmp_path):
+        tree = tmp_path / "imgs"
+        _image_tree(tree, n=4)
+        return generate_shards(str(tree), str(tmp_path / "out"),
+                               num_shards=2, scale_to=None)
+
+    def test_counts_from_sidecars(self, tmp_path):
+        paths = self._make_shards(tmp_path)
+        ds = RecordShardDataSet(str(tmp_path / "out"))
+        assert ds.size() == 8
+        assert not ds._counts or ds._meta_counts is not None
+
+    def test_counts_from_shards_json_without_sidecars(self, tmp_path):
+        paths = self._make_shards(tmp_path)
+        for p in paths:
+            (tmp_path / "out" / (p.split("/")[-1] + ".idx")).unlink()
+        ds = RecordShardDataSet(str(tmp_path / "out"))
+        assert ds._meta_counts is not None
+        assert ds.size() == 8
+
+    def test_counts_by_header_seek_when_no_metadata(self, tmp_path):
+        paths = self._make_shards(tmp_path)
+        import os
+        for p in paths:
+            os.unlink(p + ".idx")
+        os.unlink(tmp_path / "out" / "shards.json")
+        ds = RecordShardDataSet(str(tmp_path / "out"))
+        assert ds._meta_counts is None
+        assert ds.size() == 8
+        assert ds.local_size() == 8
+
+    def test_counting_is_lazy(self, tmp_path):
+        self._make_shards(tmp_path)
+        ds = RecordShardDataSet(str(tmp_path / "out"))
+        assert ds._counts == {}   # nothing counted until size() is asked
