@@ -174,18 +174,10 @@ class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
 
     def __init__(self, folder_or_paths, process_index: int = 0,
                  process_count: int = 1):
-        self._meta_counts = None
         if isinstance(folder_or_paths, (str, Path)):
             self._all_paths = sorted(
                 str(p) for p in Path(folder_or_paths).iterdir()
                 if p.name.endswith(SHARD_SUFFIX))
-            meta = Path(folder_or_paths) / "shards.json"
-            if meta.exists():
-                m = json.loads(meta.read_text())
-                if len(m.get("counts", [])) == len(self._all_paths):
-                    # generate_shards writes counts in sorted-path order
-                    self._meta_counts = dict(zip(self._all_paths,
-                                                 m["counts"]))
         else:
             self._all_paths = [str(p) for p in folder_or_paths]
         if not self._all_paths:
@@ -199,14 +191,39 @@ class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
                 f"process {process_index}/{process_count} got no shards — "
                 "fewer shard files than processes")
         self._counts: dict = {}
+        self._meta_counts: dict | None = None
+        self._meta_loaded = False
         self._index = np.arange(len(self._local))
+
+    def _load_meta(self):
+        """shards.json from the shards' own directory (works for both
+        folder and path-list construction), loaded once on demand."""
+        if self._meta_loaded:
+            return
+        self._meta_loaded = True
+        parents = {str(Path(p).parent) for p in self._all_paths}
+        if len(parents) != 1:
+            return
+        meta = Path(parents.pop()) / "shards.json"
+        if meta.exists():
+            m = json.loads(meta.read_text())
+            if len(m.get("counts", [])) == len(self._all_paths):
+                # generate_shards writes counts in sorted-path order
+                self._meta_counts = dict(
+                    zip(sorted(self._all_paths), m["counts"]))
 
     def _count(self, path: str) -> int:
         if path not in self._counts:
-            if self._meta_counts is not None:
-                self._counts[path] = self._meta_counts[path]
-            else:
+            # the .idx sidecar is written atomically with the shard by
+            # RecordWriter.close, so it wins over the batch-level
+            # shards.json (which goes stale if one shard is regenerated)
+            if Path(path + ".idx").exists():
                 self._counts[path] = shard_count(path)
+            else:
+                self._load_meta()
+                self._counts[path] = (self._meta_counts[path]
+                                      if self._meta_counts is not None
+                                      else shard_count(path))
         return self._counts[path]
 
     def is_sharded(self):

@@ -75,7 +75,7 @@ def main(argv=None):
     from bigdl_tpu.models import (Inception_v1_NoAuxClassifier,
                                   Inception_v2_NoAuxClassifier)
     from bigdl_tpu.optim import (Optimizer, Poly, SGD, Top1Accuracy,
-                                 Top5Accuracy, max_iteration,
+                                 Top5Accuracy, max_epoch, max_iteration,
                                  several_iteration)
     from bigdl_tpu.utils import file as bfile
 
@@ -98,11 +98,18 @@ def main(argv=None):
 
     optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
     # reference recipe (inception/Train.scala:70-88): lr 0.0898,
-    # Poly(0.5, maxIteration)
+    # Poly(0.5, maxIteration). When the run ends on --maxEpoch instead,
+    # the Poly horizon must follow it, or LR hits 0 mid-run and the rest
+    # of the budget trains at lr=0.
+    if args.maxEpoch:
+        import math
+        poly_max = math.ceil(train_set.size() / batch) * args.maxEpoch
+    else:
+        poly_max = args.maxIteration
     optimizer.set_optim_method(SGD(
         learning_rate=args.learningRate or 0.0898,
         weight_decay=0.0001, momentum=0.9,
-        learning_rate_schedule=Poly(0.5, args.maxIteration)))
+        learning_rate_schedule=Poly(0.5, poly_max)))
     if args.state:
         optimizer.set_state(bfile.load(args.state))
     optimizer.set_validation(several_iteration(620), val_set,
@@ -111,7 +118,10 @@ def main(argv=None):
         optimizer.set_checkpoint(args.checkpoint, several_iteration(620))
         if args.overWrite:
             optimizer.overwrite_checkpoint()
-    optimizer.set_end_when(max_iteration(args.maxIteration))
+    # the reference recipe ends on iteration count (Train.scala:83);
+    # honor an explicit --maxEpoch when the user passes one
+    optimizer.set_end_when(max_epoch(args.maxEpoch) if args.maxEpoch
+                           else max_iteration(args.maxIteration))
     optimizer.optimize()
 
 

@@ -1,0 +1,91 @@
+"""Generate datasets in the reference's on-disk formats for smoke runs.
+
+The reference's ``run.example.sh`` downloads MNIST/CIFAR/ImageNet before
+training. In offline environments this module synthesizes the same file
+formats instead, so the one-command train path works anywhere:
+
+- mnist:    idx files (train/t10k images+labels) per Yann LeCun layout
+- cifar:    data_batch_{1..5}.bin / test_batch.bin (3073-byte records)
+- imagenet: class-per-subfolder JPEG tree (feed to imagenet_gen for shards)
+
+Run: ``python -m bigdl_tpu.models.utils.make_synthetic_data mnist -o DIR``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+
+def make_mnist(out: str, n_train: int = 2048, n_test: int = 512):
+    os.makedirs(out, exist_ok=True)
+    rng = np.random.default_rng(0)
+
+    def write_pair(prefix, n):
+        imgs = rng.integers(0, 256, (n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, (n,), dtype=np.uint8)
+        with open(os.path.join(out, f"{prefix}-images-idx3-ubyte"),
+                  "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(os.path.join(out, f"{prefix}-labels-idx1-ubyte"),
+                  "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+
+    write_pair("train", n_train)
+    write_pair("t10k", n_test)
+
+
+def make_cifar(out: str, per_batch: int = 512):
+    os.makedirs(out, exist_ok=True)
+    rng = np.random.default_rng(0)
+
+    def write_bin(name, n):
+        with open(os.path.join(out, name), "wb") as f:
+            labels = rng.integers(0, 10, (n,), dtype=np.uint8)
+            imgs = rng.integers(0, 256, (n, 3072), dtype=np.uint8)
+            for lab, img in zip(labels, imgs):
+                f.write(bytes([lab]))
+                f.write(img.tobytes())
+
+    for i in range(1, 6):
+        write_bin(f"data_batch_{i}.bin", per_batch)
+    write_bin("test_batch.bin", per_batch)
+
+
+def make_imagenet(out: str, classes: int = 10, per_class: int = 20,
+                  size: int = 256):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for split in ("train", "val"):
+        for c in range(1, classes + 1):
+            d = os.path.join(out, split, f"n{c:08d}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per_class):
+                arr = rng.integers(0, 256, (size, size, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"img_{i:04d}.jpg"), "JPEG")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dataset", choices=["mnist", "cifar", "imagenet"])
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-n", type=int, default=None,
+                   help="records per split/batch/class (format-dependent)")
+    args = p.parse_args(argv)
+    if args.dataset == "mnist":
+        make_mnist(args.output, *( (args.n, max(args.n // 4, 1))
+                                   if args.n else ()))
+    elif args.dataset == "cifar":
+        make_cifar(args.output, *((args.n,) if args.n else ()))
+    else:
+        make_imagenet(args.output, per_class=args.n or 20)
+    print(f"synthetic {args.dataset} written to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,9 @@ from bigdl_tpu.nn.structural import (
 from bigdl_tpu.nn.table_ops import (CAddTable, CSubTable, CMulTable,
                                     CDivTable, CMaxTable, CMinTable,
                                     DotProduct, PairwiseDistance,
-                                    CosineDistance)
-from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, GRU, Recurrent,
+                                    CosineDistance, MixtureTable,
+                                    MaskedSelect)
+from bigdl_tpu.nn.recurrent import (Cell, RnnCell, RNN, LSTM, GRU, Recurrent,
                                     BiRecurrent, TimeDistributed)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, MSECriterion, BCECriterion, CrossEntropyCriterion,

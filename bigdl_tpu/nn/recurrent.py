@@ -25,8 +25,8 @@ from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import Module, Container, _fold
 from bigdl_tpu.tensor import default_dtype
 
-__all__ = ["Cell", "RnnCell", "LSTM", "GRU", "Recurrent", "TimeDistributed",
-           "BiRecurrent"]
+__all__ = ["Cell", "RnnCell", "RNN", "LSTM", "GRU", "Recurrent",
+           "TimeDistributed", "BiRecurrent"]
 
 _ACT = {"tanh": jnp.tanh, "relu": jax.nn.relu,
         "sigmoid": jax.nn.sigmoid}
@@ -255,3 +255,8 @@ class TimeDistributed(Container):
         y, s = self.modules[0].apply(params["0"], state["0"], flat,
                                      training=training, rng=rng)
         return y.reshape((N, T) + y.shape[1:]), {"0": s}
+
+
+# the reference file nn/RNN.scala names its cell class RnnCell; RNN is the
+# name users reach for
+RNN = RnnCell

@@ -12,7 +12,8 @@ from functools import reduce
 from bigdl_tpu.nn.module import Module
 
 __all__ = ["CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
-           "CMinTable", "DotProduct", "PairwiseDistance", "CosineDistance"]
+           "CMinTable", "DotProduct", "PairwiseDistance", "CosineDistance",
+           "MixtureTable", "MaskedSelect"]
 
 
 class CAddTable(Module):
@@ -80,3 +81,57 @@ class CosineDistance(Module):
         an = jnp.linalg.norm(a, axis=-1)
         bn = jnp.linalg.norm(b, axis=-1)
         return jnp.sum(a * b, axis=-1) / jnp.maximum(an * bn, 1e-12), state
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend of a (gater, experts) table
+    (reference nn/MixtureTable.scala:37-80).
+
+    ``experts`` may be a table of E tensors (batch, ...) — blended with
+    gater (batch, E) — or a single stacked tensor whose axis ``dim``
+    indexes the experts. Unbatched 1-D gaters work like the reference's
+    single-example path.
+    """
+
+    def __init__(self, dim: int | None = None):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        gater, experts = x[0], x[1]
+        batched = gater.ndim >= 2
+        if isinstance(experts, (tuple, list)):
+            out = None
+            for e, expert in enumerate(experts):
+                g = gater[:, e] if batched else gater[e]
+                shape = (g.shape + (1,) * (expert.ndim - g.ndim)
+                         if batched else ())
+                term = expert * (g.reshape(shape) if batched else g)
+                out = term if out is None else out + term
+            return out, state
+        # stacked experts tensor: mix along self.dim (1-based like the
+        # reference; default = first non-batch axis)
+        dim = (self.dim - 1) if self.dim is not None else (1 if batched
+                                                          else 0)
+        e_count = experts.shape[dim]
+        shape = [1] * experts.ndim
+        if batched:
+            shape[0] = gater.shape[0]
+        shape[dim] = e_count
+        g = gater.reshape(shape)
+        return jnp.sum(experts * g, axis=dim), state
+
+
+class MaskedSelect(Module):
+    """torch.maskedSelect over a (tensor, mask) table
+    (reference nn/MaskedSelect.scala:33-66).
+
+    The output length depends on the mask VALUES, so this module is
+    eager-only: calling it inside ``jit`` raises XLA's dynamic-shape
+    error. Inside compiled code, multiply by the mask (static shape)
+    instead; this module exists for API parity and host-side use.
+    """
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        t, mask = x[0], x[1]
+        return t[mask.astype(bool)], state

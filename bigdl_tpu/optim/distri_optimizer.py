@@ -148,28 +148,24 @@ class DistriOptimizer(Optimizer):
             batch = next(data_iter)
             if isinstance(batch.data, jax.Array):
                 # DevicePrefetcher already placed the batch (overlapped
-                # with the previous device step) — don't round-trip it.
-                # A mesh-sharded prefetcher checked divisibility before
-                # placement; re-check here for sharding-less prefetchers
-                # and user-placed arrays.
+                # with the previous device step) — don't round-trip it
                 data, labels = batch.data, batch.labels
                 global_n = data.shape[0]
-                if global_n % n_shards != 0:
-                    raise ValueError(
-                        f"global batch {global_n} not divisible by "
-                        f"{n_shards} mesh devices (reference "
-                        "Utils.getBatchSize divisibility requirement, "
-                        "dataset/Utils.scala:25-47)")
+                needs_shard = False
             else:
                 data = np.asarray(batch.data)
                 labels = np.asarray(batch.labels)
                 global_n = data.shape[0] * jax.process_count()
-                if global_n % n_shards != 0:
-                    raise ValueError(
-                        f"global batch {global_n} not divisible by "
-                        f"{n_shards} mesh devices (reference "
-                        "Utils.getBatchSize divisibility requirement, "
-                        "dataset/Utils.scala:25-47)")
+                needs_shard = True
+            if global_n % n_shards != 0:
+                # a mesh-sharded DevicePrefetcher raised this before
+                # placement; this covers host batches, sharding-less
+                # prefetchers, and user-placed arrays
+                raise ValueError(
+                    f"global batch {global_n} not divisible by "
+                    f"{n_shards} mesh devices (reference Utils.getBatchSize "
+                    "divisibility requirement, dataset/Utils.scala:25-47)")
+            if needs_shard:
                 data, labels = self._shard_batch(data, labels, batch_shard)
             t1 = time.perf_counter()
             data_time = t1 - t0

@@ -1,0 +1,113 @@
+#!/bin/bash
+# One-command data-prep + train entry point (the reference's
+# scripts/run.example.sh role, minus Spark: jobs launch as python -m mains
+# over the local TPU mesh).
+#
+# Examples:
+#   ./scripts/run.example.sh --model lenet --batch-size 128 --max-epoch 2
+#   ./scripts/run.example.sh --model vgg --batch-size 128
+#   ./scripts/run.example.sh --model inception-v1 --batch-size 128 \
+#       --learning-rate 0.0898
+#   ./scripts/run.example.sh --model perf
+#
+# Data handling mirrors the reference: an existing --data-dir is used as-is;
+# otherwise the dataset is downloaded (MNIST/CIFAR) when the network allows,
+# falling back to synthetic data in the same on-disk format so the path
+# works offline. ImageNet is always synthesized (the reference pulls it from
+# HDFS) and converted to record shards with the shard generator.
+set -e
+
+MODEL=""
+BATCH_SIZE=""
+LEARNING_RATE=""
+MAX_EPOCH=""
+DATA_DIR=""
+ME=$(basename "$0")
+cd "$(dirname "$0")/.."
+
+usage() {
+    echo "Usage: $ME --model lenet|vgg|inception-v1|perf [--batch-size N]"
+    echo "          [--learning-rate F] [--max-epoch N] [--data-dir DIR]"
+}
+
+while [ $# -gt 0 ]; do
+    case $1 in
+        -m|--model) MODEL=$2; shift 2 ;;
+        -b|--batch-size) BATCH_SIZE=$2; shift 2 ;;
+        -l|--learning-rate) LEARNING_RATE=$2; shift 2 ;;
+        -e|--max-epoch) MAX_EPOCH=$2; shift 2 ;;
+        -f|--data-dir) DATA_DIR=$2; shift 2 ;;
+        -h|--help) usage; exit 0 ;;
+        *) echo "unknown option: $1"; usage; exit 1 ;;
+    esac
+done
+
+[[ ! $MODEL =~ ^(lenet|vgg|inception-v1|perf)$ ]] && {
+    echo "ERROR: model must be one of lenet, vgg, inception-v1 or perf"
+    exit 1
+}
+
+fetch() {  # fetch URL DEST — best-effort download, returns nonzero offline
+    command -v wget >/dev/null && wget -q --tries=1 -T 10 -P "$2" "$1"
+}
+
+ARGS=()
+[ -n "$BATCH_SIZE" ] && ARGS+=(-b "$BATCH_SIZE")
+[ -n "$LEARNING_RATE" ] && ARGS+=(-r "$LEARNING_RATE")
+[ -n "$MAX_EPOCH" ] && ARGS+=(-e "$MAX_EPOCH")
+
+case $MODEL in
+    lenet)
+        DATA_DIR=${DATA_DIR:-./data/mnist}
+        if [ ! -f "$DATA_DIR/train-images-idx3-ubyte" ] && \
+           [ ! -f "$DATA_DIR/train-images-idx3-ubyte.gz" ]; then
+            mkdir -p "$DATA_DIR"
+            echo "Fetching MNIST (falls back to synthetic offline) ..."
+            for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+                     t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+                fetch "http://yann.lecun.com/exdb/mnist/$f.gz" "$DATA_DIR" \
+                    || true
+            done
+            if [ ! -f "$DATA_DIR/train-images-idx3-ubyte.gz" ]; then
+                python -m bigdl_tpu.models.utils.make_synthetic_data mnist \
+                    -o "$DATA_DIR"
+            fi
+        fi
+        exec python -m bigdl_tpu.models.lenet.train -f "$DATA_DIR" "${ARGS[@]}"
+        ;;
+    vgg)
+        DATA_DIR=${DATA_DIR:-./data/cifar-10-batches-bin}
+        if [ ! -f "$DATA_DIR/data_batch_1.bin" ]; then
+            mkdir -p "$DATA_DIR"
+            echo "Fetching CIFAR-10 (falls back to synthetic offline) ..."
+            if fetch "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz" \
+                     "$DATA_DIR"; then
+                tar -xzf "$DATA_DIR/cifar-10-binary.tar.gz" -C "$DATA_DIR" \
+                    --strip-components=1
+            else
+                python -m bigdl_tpu.models.utils.make_synthetic_data cifar \
+                    -o "$DATA_DIR"
+            fi
+        fi
+        exec python -m bigdl_tpu.models.vgg.train -f "$DATA_DIR" "${ARGS[@]}"
+        ;;
+    inception-v1)
+        DATA_DIR=${DATA_DIR:-./data/imagenet}
+        if [ ! -f "$DATA_DIR/shards/shards.json" ]; then
+            if [ ! -d "$DATA_DIR/train" ]; then
+                echo "Synthesizing an ImageNet-format image tree ..."
+                python -m bigdl_tpu.models.utils.make_synthetic_data \
+                    imagenet -o "$DATA_DIR"
+            fi
+            echo "Generating record shards (ImageNetSeqFileGenerator role) ..."
+            python -m bigdl_tpu.models.utils.imagenet_gen \
+                -f "$DATA_DIR/train" -o "$DATA_DIR/shards"
+        fi
+        exec python -m bigdl_tpu.models.inception.train \
+            -f "$DATA_DIR/shards" "${ARGS[@]}"
+        ;;
+    perf)
+        exec python -m bigdl_tpu.models.utils.perf -m inception_v1 \
+            ${BATCH_SIZE:+-b "$BATCH_SIZE"}
+        ;;
+esac

@@ -355,3 +355,42 @@ class TestEmbedding:
         assert y.shape == (1, 3, 4)
         assert_close(y[0, 0], m.params["weight"][0])
         assert_close(y[0, 2], m.params["weight"][9])
+
+
+class TestMixtureAndMasked:
+    def test_mixture_table_expert_list(self):
+        rng = np.random.default_rng(0)
+        gater = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+        experts = [jnp.asarray(rng.standard_normal((4, 5)).astype(np.float32))
+                   for _ in range(3)]
+        m = nn.MixtureTable()
+        y, _ = m.apply({}, {}, (gater, experts))
+        ref = sum(np.asarray(gater)[:, e:e + 1] * np.asarray(experts[e])
+                  for e in range(3))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-6)
+
+    def test_mixture_table_stacked_experts(self):
+        rng = np.random.default_rng(1)
+        gater = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+        experts = jnp.asarray(rng.standard_normal((4, 3, 5))
+                              .astype(np.float32))
+        m = nn.MixtureTable(dim=2)   # 1-based, mix over axis 1
+        y, _ = m.apply({}, {}, (gater, experts))
+        ref = np.einsum("be,bef->bf", np.asarray(gater),
+                        np.asarray(experts))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5)
+
+    def test_mixture_table_unbatched(self):
+        gater = jnp.asarray(np.asarray([0.25, 0.75], np.float32))
+        experts = [jnp.asarray(np.ones(4, np.float32)),
+                   jnp.asarray(np.full(4, 3.0, np.float32))]
+        y, _ = nn.MixtureTable().apply({}, {}, (gater, experts))
+        np.testing.assert_allclose(np.asarray(y), np.full(4, 2.5), rtol=1e-6)
+
+    def test_masked_select_eager_matches_torch(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        mask = (x % 3 == 0)
+        y, _ = nn.MaskedSelect().apply(
+            {}, {}, (jnp.asarray(x), jnp.asarray(mask)))
+        ref = torch.masked_select(torch.tensor(x), torch.tensor(mask))
+        np.testing.assert_array_equal(np.asarray(y), ref.numpy())

@@ -196,15 +196,32 @@ class TestShardCounting:
         paths = self._make_shards(tmp_path)
         ds = RecordShardDataSet(str(tmp_path / "out"))
         assert ds.size() == 8
-        assert not ds._counts or ds._meta_counts is not None
+
+    def test_sidecar_wins_over_stale_shards_json(self, tmp_path):
+        """Regenerating one shard updates its .idx; shards.json goes
+        stale. The atomic per-file sidecar must take precedence."""
+        paths = self._make_shards(tmp_path)
+        with RecordWriter(paths[0]) as w:   # rewrite shard 0 with 1 record
+            w.write(b"only", 1.0)
+        ds = RecordShardDataSet(str(tmp_path / "out"))
+        assert ds.size() == 1 + 4   # 1 rewritten + 4 in shard 1
+
+    def test_shards_json_used_for_path_list_construction(self, tmp_path):
+        import os
+        paths = self._make_shards(tmp_path)
+        for p in paths:
+            os.unlink(p + ".idx")
+        ds = RecordShardDataSet(paths)   # list form, not folder form
+        assert ds.size() == 8
+        assert ds._meta_counts is not None
 
     def test_counts_from_shards_json_without_sidecars(self, tmp_path):
         paths = self._make_shards(tmp_path)
         for p in paths:
             (tmp_path / "out" / (p.split("/")[-1] + ".idx")).unlink()
         ds = RecordShardDataSet(str(tmp_path / "out"))
-        assert ds._meta_counts is not None
         assert ds.size() == 8
+        assert ds._meta_counts is not None
 
     def test_counts_by_header_seek_when_no_metadata(self, tmp_path):
         paths = self._make_shards(tmp_path)
