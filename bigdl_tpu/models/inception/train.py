@@ -103,7 +103,14 @@ def main(argv=None):
     # of the budget trains at lr=0.
     if args.maxEpoch:
         import math
-        poly_max = math.ceil(train_set.size() / batch) * args.maxEpoch
+
+        import jax
+        # iterations/epoch uses the GLOBAL batch: every host consumes
+        # `batch` records per step (distri_optimizer counts
+        # batch * process_count toward the epoch)
+        global_batch = batch * jax.process_count()
+        poly_max = math.ceil(train_set.size() / global_batch) \
+            * args.maxEpoch
     else:
         poly_max = args.maxIteration
     optimizer.set_optim_method(SGD(

@@ -1,0 +1,86 @@
+"""Attention modules.
+
+The reference predates transformers — its long-sequence story is scan
+RNNs (SURVEY §5.7). On TPU, attention is the long-context workhorse, so
+the module library carries a MultiHeadAttention whose core can run
+locally, ring-parallel, or Ulysses-parallel over the mesh ``seq`` axis
+(parallel/sequence.py) without changing the module's parameters.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.tensor import activation_dtype, compute_dtype, default_dtype
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(Module):
+    """Self-attention over (batch, seq, embed).
+
+    ``sequence_parallel`` selects the attention core: None (local),
+    "ring" or "ulysses" (sequence-sharded over ``mesh_axis``; inputs must
+    then be seq-sharded arrays under an active mesh, and seq/heads must
+    divide the axis size — see parallel/sequence.py).
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 causal: bool = False, with_bias: bool = True,
+                 sequence_parallel: str | None = None,
+                 mesh_axis: str = "seq"):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.with_bias = with_bias
+        self.sequence_parallel = sequence_parallel
+        self.mesh_axis = mesh_axis
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 4)
+        p = {}
+        for name, k in zip(("q", "k", "v", "out"), ks):
+            w = init_mod.init_weight(init_mod.Xavier, k,
+                                     (self.embed_dim, self.embed_dim),
+                                     fan_in=self.embed_dim,
+                                     fan_out=self.embed_dim)
+            p[f"{name}_weight"] = w
+            if self.with_bias:
+                p[f"{name}_bias"] = jnp.zeros((self.embed_dim,),
+                                              default_dtype())
+        return p
+
+    def _proj(self, params, name, x):
+        y = jnp.matmul(x.astype(compute_dtype()),
+                       params[f"{name}_weight"].astype(compute_dtype()).T)
+        if self.with_bias:
+            y = y + params[f"{name}_bias"].astype(compute_dtype())
+        return y
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        from bigdl_tpu.parallel import sequence as seq
+        b, s, e = x.shape
+        heads = (self.num_heads, self.head_dim)
+        q = self._proj(params, "q", x).reshape(b, s, *heads)
+        k = self._proj(params, "k", x).reshape(b, s, *heads)
+        v = self._proj(params, "v", x).reshape(b, s, *heads)
+        if self.sequence_parallel == "ring":
+            o = seq.ring_attention(q, k, v, causal=self.causal,
+                                   axis=self.mesh_axis)
+        elif self.sequence_parallel == "ulysses":
+            o = seq.ulysses_attention(q, k, v, causal=self.causal,
+                                      axis=self.mesh_axis)
+        else:
+            o = seq.dot_product_attention(q, k, v, causal=self.causal)
+        y = self._proj(params, "out", o.reshape(b, s, e))
+        return y.astype(activation_dtype()), state
+
+    def __repr__(self):
+        return (f"MultiHeadAttention({self.embed_dim}, "
+                f"heads={self.num_heads}, causal={self.causal}, "
+                f"sp={self.sequence_parallel})")

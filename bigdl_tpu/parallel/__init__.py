@@ -3,3 +3,7 @@ reference's Engine thread pools + Spark BlockManager parameter server)."""
 
 from bigdl_tpu.parallel.engine import (Engine, get_mesh, data_sharding,
                                        replicated)
+from bigdl_tpu.parallel.sequence import (dot_product_attention,
+                                         ring_attention,
+                                         ring_attention_sharded,
+                                         ulysses_attention)

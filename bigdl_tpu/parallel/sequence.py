@@ -1,0 +1,172 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference handles sequences functionally (scan-unrolled RNNs, padded
+batching — SURVEY §5.7) and has no sequence parallelism; on TPU, long
+contexts are first-class, so this module provides the two standard schemes
+over the mesh's ``seq`` axis (parallel/engine.py reserves it):
+
+- ``ring_attention``: q/k/v stay sequence-sharded; K/V blocks rotate
+  around the ring via ``ppermute`` while each shard folds them into a
+  numerically-stable online softmax (the Blockwise/RingAttention
+  construction — see PAPERS.md "Ring Attention with Blockwise
+  Transformers"). Peak memory per chip is O(seq/N), communication rides
+  ICI neighbor links, and the result is bit-equivalent to full attention
+  up to float summation order.
+- ``ulysses_attention``: two ``all_to_all``s re-shard sequence->heads,
+  run full local attention per head group, and shard back (the
+  DeepSpeed-Ulysses construction). Cheaper collectives for models with
+  enough heads; requires heads % mesh[seq] == 0.
+
+Both are pure functions differentiable end-to-end (the ring loop is a
+Python unroll over the static mesh size, so autodiff just works), usable
+eagerly or inside jit/pjit.
+
+Shapes follow (batch, seq, heads, head_dim) throughout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.parallel.collective import shard_map
+from bigdl_tpu.parallel.engine import get_mesh
+
+__all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]
+
+_NEG = -1e9  # finite mask value: keeps exp(s - m) well-defined everywhere
+
+
+def _qkv_spec(mesh, axis, batch_axis):
+    """Partition spec for (B, S, H, D): sequence on ``axis``, batch on
+    ``batch_axis`` ("auto" = the mesh's data axis when present, so a
+    dp x sp mesh keeps its batch shards instead of all-gathering them)."""
+    if batch_axis == "auto":
+        batch_axis = ("data" if "data" in mesh.axis_names
+                      and axis != "data" else None)
+    return P(batch_axis, axis)
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          scale: float | None = None,
+                          q_offset: int = 0, kv_offset: int = 0):
+    """Reference (single-device) attention over (B, S, H, D).
+
+    ``q_offset``/``kv_offset`` are the global positions of element 0 —
+    how causal masking stays correct on sequence shards.
+    """
+    f32 = jnp.float32
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((kpos > qpos)[None, None], _NEG, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(f32)).astype(q.dtype)
+
+
+def _ring_body(q, k, v, *, axis, n, causal, scale):
+    """Per-shard ring attention: local q block, rotating k/v blocks."""
+    f32 = jnp.float32
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    idx = jax.lax.axis_index(axis)
+    qf = q.astype(f32) * scale
+
+    m = jnp.full((b, h, sq), -jnp.inf, f32)     # running row max
+    l = jnp.zeros((b, h, sq), f32)              # running denominator
+    o = jnp.zeros((b, sq, h, d), f32)           # running numerator
+    perm = [(j, (j - 1) % n) for j in range(n)]  # receive from the right
+
+    for t in range(n):
+        src = (idx + t) % n                      # global block id of k/v
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(f32))
+        if causal:
+            qpos = idx * sq + jnp.arange(sq)[:, None]
+            kpos = src * skv + jnp.arange(skv)[None, :]
+            s = jnp.where((kpos > qpos)[None, None], _NEG, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # exp(-inf - -inf) can't arise: s is finite (mask is finite)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * jnp.moveaxis(corr, 1, 2)[..., None] \
+            + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(f32))
+        m = m_new
+        if t != n - 1:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+
+    out = o / jnp.moveaxis(l, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: float | None = None, axis: str = "seq",
+                   mesh: Mesh | None = None, batch_axis="auto"):
+    """Sequence-parallel attention; q/k/v sharded on dim 1 over ``axis``.
+
+    Call eagerly with global arrays (this wrapper shards them) or use
+    ``ring_attention_sharded`` inside an existing shard_map/pjit region.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    if q.shape[1] % n or k.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]}/{k.shape[1]} not divisible by "
+            f"mesh axis '{axis}' size {n}")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def body(qb, kb, vb):
+        return _ring_body(qb, kb, vb, axis=axis, n=n, causal=causal,
+                          scale=scale)
+
+    spec = _qkv_spec(mesh, axis, batch_axis)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)
+
+
+def ring_attention_sharded(q, k, v, *, causal: bool = False,
+                           scale: float | None = None, axis: str = "seq",
+                           axis_size: int | None = None):
+    """The per-shard ring computation, for use INSIDE shard_map/pjit where
+    ``q``/``k``/``v`` are already the local sequence blocks."""
+    n = axis_size if axis_size is not None else jax.lax.axis_size(axis)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ring_body(q, k, v, axis=axis, n=n, causal=causal, scale=scale)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: float | None = None, axis: str = "seq",
+                      mesh: Mesh | None = None, batch_axis="auto"):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
+
+    Re-shards (B, S/N, H, D) -> (B, S, H/N, D) with one all_to_all, runs
+    exact local attention over the full sequence for its head group, and
+    re-shards back. Requires H % N == 0.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"heads {q.shape[2]} not divisible by mesh axis "
+                         f"'{axis}' size {n}")
+    if q.shape[1] % n or k.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]}/{k.shape[1]} not divisible by "
+            f"mesh axis '{axis}' size {n}")
+
+    def body(qb, kb, vb):
+        # seq-sharded -> head-sharded: split heads, gather sequence
+        def to_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+        qh, kh, vh = to_heads(qb), to_heads(kb), to_heads(vb)
+        out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+        # head-sharded -> seq-sharded
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = _qkv_spec(mesh, axis, batch_axis)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -59,16 +59,24 @@ ARGS=()
 case $MODEL in
     lenet)
         DATA_DIR=${DATA_DIR:-./data/mnist}
-        if [ ! -f "$DATA_DIR/train-images-idx3-ubyte" ] && \
-           [ ! -f "$DATA_DIR/train-images-idx3-ubyte.gz" ]; then
+        MNIST_FILES="train-images-idx3-ubyte train-labels-idx1-ubyte \
+t10k-images-idx3-ubyte t10k-labels-idx1-ubyte"
+        have_mnist() {
+            for f in $MNIST_FILES; do
+                [ -f "$DATA_DIR/$f" ] || [ -f "$DATA_DIR/$f.gz" ] || return 1
+            done
+        }
+        if ! have_mnist; then
             mkdir -p "$DATA_DIR"
             echo "Fetching MNIST (falls back to synthetic offline) ..."
-            for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
-                     t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+            for f in $MNIST_FILES; do
                 fetch "http://yann.lecun.com/exdb/mnist/$f.gz" "$DATA_DIR" \
                     || true
             done
-            if [ ! -f "$DATA_DIR/train-images-idx3-ubyte.gz" ]; then
+            if ! have_mnist; then
+                # a PARTIAL download (e.g. images ok, labels dropped) must
+                # not survive: mixed real/synthetic files disagree on count
+                rm -f $(printf "$DATA_DIR/%s.gz " $MNIST_FILES)
                 python -m bigdl_tpu.models.utils.make_synthetic_data mnist \
                     -o "$DATA_DIR"
             fi
