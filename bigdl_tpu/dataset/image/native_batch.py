@@ -1,0 +1,109 @@
+"""MiniBatch assembly through the native (C++) decode core.
+
+``NativeBRecToBatch`` is the drop-in fast path for the record-shard
+pipeline: ByteRecords -> (decode + crop + flip + normalize + NCHW stack)
+in ``native/btr_loader.cpp``'s thread pool, with the NEXT batch decoding
+in the background while the trainer consumes the current one. Semantics
+mirror the Python chain ``BytesToBGRImg >> BGRImgCropper >> HFlip >>
+BGRImgNormalizer >> MTImgToBatch`` (augment randomness comes from a
+different — per-record, seed-stable — stream, like the reference's
+per-thread generators differ from single-threaded order).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random import RandomGenerator
+
+__all__ = ["NativeBRecToBatch"]
+
+
+class NativeBRecToBatch(Transformer):
+    def __init__(self, batch_size: int, crop_width: int, crop_height: int,
+                 train: bool, mean_rgb, std_rgb, num_threads: int = 8,
+                 flip_prob: float | None = None):
+        from bigdl_tpu import native
+        if not native.available():
+            raise RuntimeError(
+                "native loader unavailable — use MTImgToBatch instead")
+        self.batch_size = batch_size
+        self.cw, self.ch = crop_width, crop_height
+        self.train = train
+        r, g, b = mean_rgb
+        self.mean_bgr = (b, g, r)
+        r, g, b = std_rgb
+        self.std_bgr = (b, g, r)
+        self.num_threads = num_threads
+        self.flip_prob = (0.5 if train else 0.0) if flip_prob is None \
+            else flip_prob
+        self._batch_counter = 0
+
+    def _python_decode_one(self, rec):
+        """Fallback for records libjpeg rejects (e.g. ImageNet's CMYK
+        JPEGs, which PIL converts): run the equivalent Python chain so the
+        native path trains on EXACTLY the same records as the Python
+        path — and a truly corrupt record raises loudly, as
+        MTImgToBatch's pipeline would."""
+        from bigdl_tpu.dataset.image import (BGRImgCropper,
+                                             BGRImgNormalizer,
+                                             BytesToBGRImg, CropCenter,
+                                             CropRandom, HFlip)
+        mean_b, mean_g, mean_r = self.mean_bgr
+        std_b, std_g, std_r = self.std_bgr
+        pipe = (BytesToBGRImg()
+                >> BGRImgCropper(self.cw, self.ch,
+                                 CropRandom if self.train else CropCenter)
+                >> HFlip(self.flip_prob)
+                >> BGRImgNormalizer(mean_r, mean_g, mean_b,
+                                    std_r, std_g, std_b))
+        img = next(iter(pipe(iter([rec]))))
+        return np.transpose(img.content, (2, 0, 1)).astype(np.float32)
+
+    def _decode(self, records):
+        from bigdl_tpu import native
+        jpegs = [r.data for r in records]
+        labels = np.asarray([r.label for r in records], np.float32)
+        seed = (RandomGenerator._default_seed * 1000003
+                + self._batch_counter) & (2 ** 64 - 1)
+        self._batch_counter += 1
+        batch, status = native.decode_crop_batch(
+            jpegs, self.ch, self.cw, random_crop=self.train,
+            flip_prob=self.flip_prob, mean_bgr=self.mean_bgr,
+            std_bgr=self.std_bgr, seed=seed,
+            num_threads=self.num_threads)
+        for i in np.nonzero(status != 0)[0]:
+            batch[i] = self._python_decode_one(records[int(i)])
+        return MiniBatch(batch, labels)
+
+    def __call__(self, it):
+        def chunks():
+            buf = []
+            for rec in it:
+                buf.append(rec)
+                if len(buf) == self.batch_size:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+
+        chunk_iter = chunks()
+
+        def task():
+            # record READ + decode both live in the background thread, so
+            # delivering batch k never waits on batch k+1's disk I/O
+            chunk = next(chunk_iter, None)
+            return None if chunk is None else self._decode(chunk)
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = pool.submit(task)
+            while True:
+                nxt = pool.submit(task)
+                batch = pending.result()
+                if batch is None:
+                    break
+                yield batch
+                pending = nxt

@@ -47,9 +47,22 @@ def build_pipeline(folder, batch, train, image_size=224, threads=8,
                >> BGRImgNormalizer(MEAN_RGB, std_r=STD_RGB))
     if shards:
         import jax
+
+        from bigdl_tpu import native
         ds = RecordShardDataSet(shards,
                                 process_index=jax.process_index(),
                                 process_count=jax.process_count())
+        if native.available():
+            # C++ decode core: no GIL, one call per batch
+            # (dataset/image/native_batch.py)
+            from bigdl_tpu.dataset.image.native_batch import \
+                NativeBRecToBatch
+            out = ds >> NativeBRecToBatch(batch, image_size, image_size,
+                                          train, MEAN_RGB, STD_RGB,
+                                          num_threads=threads)
+            if prefetch_sharding is not None:
+                out = out >> DevicePrefetcher(prefetch_sharding)
+            return out
         inner = BytesToBGRImg() >> augment
     else:
         paths = LocalImageFiles.paths(root, shuffle=train)

@@ -1,0 +1,116 @@
+"""ctypes bridge to the native (C++) input-pipeline core.
+
+The reference's native layer is C behind JNI (SURVEY §2.1); here the
+compute path is XLA/jaxlib and the native seam that still earns its keep
+is the data loader: ``native/btr_loader.cpp`` does threaded JPEG decode +
+augment + NCHW batch assembly without the GIL. This module compiles it on
+first use (g++ + libjpeg, cached as ``libbtr_loader.so`` next to this
+file) and exposes ``decode_crop_batch``. Everything degrades gracefully:
+``available()`` is False when the toolchain or libjpeg is missing and
+callers fall back to the pure-Python pipeline.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "decode_crop_batch", "lib_path"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, os.pardir, os.pardir, "native", "btr_loader.cpp")
+_SO = os.path.join(_HERE, "libbtr_loader.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def lib_path() -> str:
+    return _SO
+
+
+def _build() -> bool:
+    if not (shutil.which("g++") and os.path.exists(_SRC)):
+        return False
+    # compile to a private temp file and rename into place: several host
+    # processes race to first-use on a fresh node, and rename is atomic —
+    # nobody can CDLL a half-written library
+    tmp = f"{_SO}.build.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", tmp, "-ljpeg", "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.btr_decode_batch.restype = ctypes.c_int
+        lib.btr_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),              # jpegs
+            ctypes.POINTER(ctypes.c_size_t),              # sizes
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,     # n, crop_h, crop_w
+            ctypes.c_int, ctypes.c_float,                 # random_crop, flip
+            ctypes.POINTER(ctypes.c_float),               # mean_bgr
+            ctypes.POINTER(ctypes.c_float),               # std_bgr
+            ctypes.c_uint64, ctypes.c_int,                # seed, threads
+            ctypes.POINTER(ctypes.c_float),               # out
+            ctypes.POINTER(ctypes.c_int8),                # status
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_crop_batch(jpegs, crop_h: int, crop_w: int, *,
+                      random_crop: bool = False, flip_prob: float = 0.0,
+                      mean_bgr=(0.0, 0.0, 0.0), std_bgr=(1.0, 1.0, 1.0),
+                      seed: int = 0, num_threads: int = 8):
+    """Decode a list of JPEG byte strings into an (N, 3, H, W) f32 BGR
+    batch (scaled 1/255, normalized per channel). Returns (batch, status)
+    where status[i] != 0 marks a corrupt record (its slot is zeros)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no g++/libjpeg?)")
+    n = len(jpegs)
+    out = np.empty((n, 3, crop_h, crop_w), np.float32)
+    status = np.empty((n,), np.int8)
+    arr = (ctypes.c_char_p * n)(*jpegs)
+    sizes = (ctypes.c_size_t * n)(*[len(j) for j in jpegs])
+    mean = (ctypes.c_float * 3)(*[float(v) for v in mean_bgr])
+    std = (ctypes.c_float * 3)(*[float(v) for v in std_bgr])
+    lib.btr_decode_batch(
+        ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), sizes, n,
+        crop_h, crop_w, int(random_crop), float(flip_prob), mean, std,
+        int(seed) & (2 ** 64 - 1), num_threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    return out, status
