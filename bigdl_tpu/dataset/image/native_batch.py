@@ -40,14 +40,16 @@ class NativeBRecToBatch(Transformer):
         self.num_threads = num_threads
         self.flip_prob = (0.5 if train else 0.0) if flip_prob is None \
             else flip_prob
-        self._batch_counter = 0
 
-    def _python_decode_one(self, rec):
+    def _python_decode_one(self, rec, seed):
         """Fallback for records libjpeg rejects (e.g. ImageNet's CMYK
         JPEGs, which PIL converts): run the equivalent Python chain so the
         native path trains on EXACTLY the same records as the Python
         path — and a truly corrupt record raises loudly, as
-        MTImgToBatch's pipeline would."""
+        MTImgToBatch's pipeline would. The worker thread's RNG is seeded
+        from the (checkpoint-replayable) batch seed so the fallback's
+        crops/flips neither repeat per epoch nor break exact resume."""
+        RandomGenerator.seed_thread(seed & (2 ** 63 - 1))
         from bigdl_tpu.dataset.image import (BGRImgCropper,
                                              BGRImgNormalizer,
                                              BytesToBGRImg, CropCenter,
@@ -63,20 +65,18 @@ class NativeBRecToBatch(Transformer):
         img = next(iter(pipe(iter([rec]))))
         return np.transpose(img.content, (2, 0, 1)).astype(np.float32)
 
-    def _decode(self, records):
+    def _decode(self, records, seed):
         from bigdl_tpu import native
         jpegs = [r.data for r in records]
         labels = np.asarray([r.label for r in records], np.float32)
-        seed = (RandomGenerator._default_seed * 1000003
-                + self._batch_counter) & (2 ** 64 - 1)
-        self._batch_counter += 1
         batch, status = native.decode_crop_batch(
             jpegs, self.ch, self.cw, random_crop=self.train,
             flip_prob=self.flip_prob, mean_bgr=self.mean_bgr,
             std_bgr=self.std_bgr, seed=seed,
             num_threads=self.num_threads)
         for i in np.nonzero(status != 0)[0]:
-            batch[i] = self._python_decode_one(records[int(i)])
+            batch[i] = self._python_decode_one(records[int(i)],
+                                               seed ^ (int(i) + 1))
         return MiniBatch(batch, labels)
 
     def __call__(self, it):
@@ -92,16 +92,24 @@ class NativeBRecToBatch(Transformer):
 
         chunk_iter = chunks()
 
-        def task():
+        def task(seed):
             # record READ + decode both live in the background thread, so
             # delivering batch k never waits on batch k+1's disk I/O
             chunk = next(chunk_iter, None)
-            return None if chunk is None else self._decode(chunk)
+            return None if chunk is None else self._decode(chunk, seed)
+
+        def draw_seed():
+            # drawn on the CONSUMER thread: one draw per batch from the
+            # host RNG stream the checkpoint system snapshots and
+            # fast-forwards — augmentation survives exact mid-epoch
+            # resume AND differs across epochs (a process-local counter
+            # would reset on resume and replay epoch-1 seeds)
+            return int(RandomGenerator.RNG().random_int(0, 2 ** 63))
 
         with ThreadPoolExecutor(max_workers=1) as pool:
-            pending = pool.submit(task)
+            pending = pool.submit(task, draw_seed())
             while True:
-                nxt = pool.submit(task)
+                nxt = pool.submit(task, draw_seed())
                 batch = pending.result()
                 if batch is None:
                     break

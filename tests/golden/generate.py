@@ -1,0 +1,41 @@
+"""Regenerate the golden forward-output fixtures.
+
+Run from the repo root on the CPU backend:
+
+    JAX_PLATFORMS=cpu python tests/golden/generate.py
+
+The fixtures pin cross-version reproducibility of (a) parameter
+initialization under a fixed seed and (b) the forward computation of every
+zoo model (the reference checks in .t7 fixtures for the same purpose,
+SURVEY §4.2). Regenerate ONLY when an intentional change alters inits or
+model math — the diff then documents exactly which models moved.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tests.golden.spec import MODEL_SPECS, build, fixture_path  # noqa: E402
+
+
+def main():
+    for name in sorted(MODEL_SPECS):
+        model, x = build(name)
+        y, _ = model.apply(model.params, model.state, x)
+        out = np.asarray(y, np.float32)
+        leaves = jax.tree.leaves(model.params)
+        param_sum = float(sum(np.abs(np.asarray(l, np.float64)).sum()
+                              for l in leaves))
+        np.savez(fixture_path(name), output=out,
+                 param_abs_sum=np.float64(param_sum))
+        print(f"{name}: out{out.shape} sum|p|={param_sum:.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Shared model construction for golden fixtures (generator + test)."""
+import os
+
+import numpy as np
+
+FIXTURE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# name -> (ctor(models), input shape); batch 2, eval mode, f32 policy
+MODEL_SPECS = {
+    "lenet5": (lambda m: m.LeNet5(10), (2, 1, 28, 28)),
+    "alexnet_owt": (lambda m: m.AlexNet_OWT(1000), (2, 3, 224, 224)),
+    "vgg_cifar10": (lambda m: m.VggForCifar10(10), (2, 3, 32, 32)),
+    "vgg16": (lambda m: m.Vgg_16(1000), (2, 3, 224, 224)),
+    "inception_v1": (lambda m: m.Inception_v1_NoAuxClassifier(1000),
+                     (2, 3, 224, 224)),
+    "inception_v2": (lambda m: m.Inception_v2_NoAuxClassifier(1000),
+                     (2, 3, 224, 224)),
+    "resnet20_cifar": (lambda m: m.ResNet(
+        10, {"depth": 20, "shortcutType": "B",
+             "dataset": m.DatasetType.CIFAR10}), (2, 3, 32, 32)),
+    "autoencoder": (lambda m: m.Autoencoder(32), (2, 784)),
+    "simplernn": (lambda m: m.SimpleRNN(100, 40, 10), (2, 8, 100)),
+}
+
+
+def fixture_path(name: str) -> str:
+    return os.path.join(FIXTURE_DIR, f"{name}.npz")
+
+
+def build(name):
+    import jax
+
+    from bigdl_tpu import models
+
+    ctor, shape = MODEL_SPECS[name]
+    model = ctor(models)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    x = np.random.default_rng(42).standard_normal(shape).astype(np.float32)
+    return model, x

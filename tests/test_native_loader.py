@@ -124,3 +124,24 @@ class TestNativeBatchTransformer:
         np.testing.assert_array_equal(
             np.concatenate([b.labels for b in batches]),
             np.arange(1, 11, dtype=np.float32))
+
+    def test_augment_replayable_from_host_rng_state(self, tmp_path):
+        """Batch seeds come from the checkpointed host RNG stream: the
+        same stream state must replay identical augmentation (exact
+        mid-epoch resume), and an advanced stream must differ."""
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.recordio import RecordWriter, read_records
+        from bigdl_tpu.utils.random import RandomGenerator
+        p = tmp_path / "s.brec"
+        with RecordWriter(str(p)) as w:
+            for i in range(4):
+                w.write(_jpeg(seed=i), float(i + 1))
+        t = NativeBRecToBatch(4, 24, 24, train=True, mean_rgb=MEAN_RGB,
+                              std_rgb=STD_RGB)
+        RandomGenerator.seed_thread(123)
+        a = list(t(read_records(str(p))))[0].data
+        RandomGenerator.seed_thread(123)
+        b = list(t(read_records(str(p))))[0].data
+        np.testing.assert_array_equal(a, b)
+        c = list(t(read_records(str(p))))[0].data   # stream advanced
+        assert not np.array_equal(a, c)
