@@ -98,12 +98,21 @@ class NativeBRecToBatch(Transformer):
             chunk = next(chunk_iter, None)
             return None if chunk is None else self._decode(chunk, seed)
 
+        eval_counter = [0]
+
         def draw_seed():
-            # drawn on the CONSUMER thread: one draw per batch from the
-            # host RNG stream the checkpoint system snapshots and
-            # fast-forwards — augmentation survives exact mid-epoch
+            # Train: drawn on the CONSUMER thread — one draw per batch
+            # from the host RNG stream the checkpoint system snapshots
+            # and fast-forwards, so augmentation survives exact mid-epoch
             # resume AND differs across epochs (a process-local counter
-            # would reset on resume and replay epoch-1 seeds)
+            # would reset on resume and replay epoch-1 seeds).
+            # Eval: MUST NOT touch the checkpointed stream (a validation
+            # pass would advance it past what resume replays) — a local
+            # counter still varies per batch for flip_prob>0 eval setups.
+            if not self.train:
+                eval_counter[0] += 1
+                return RandomGenerator._default_seed + 0x9E3779B1 \
+                    * eval_counter[0]
             return int(RandomGenerator.RNG().random_int(0, 2 ** 63))
 
         with ThreadPoolExecutor(max_workers=1) as pool:

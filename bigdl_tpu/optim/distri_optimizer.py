@@ -48,10 +48,15 @@ class DistriOptimizer(Optimizer):
     """(reference optim/DistriOptimizer.scala)"""
 
     def __init__(self, model, dataset, criterion, batch_size=None, *,
-                 mesh=None, shard_optim_state: bool = False, **kw):
+                 mesh=None, shard_optim_state: bool = False,
+                 tensor_parallel: bool | str = False, **kw):
         super().__init__(model, dataset, criterion, batch_size, **kw)
         self.mesh = mesh
         self.shard_optim_state = shard_optim_state
+        # True / axis name: store params sharded over the mesh 'model'
+        # axis and let XLA's SPMD partitioner split the math
+        # (parallel/tensor_parallel.py)
+        self.tensor_parallel = tensor_parallel
 
     def _shard_batch(self, data, labels, sharding):
         """Lay a host batch out across the data axis.
@@ -85,9 +90,29 @@ class DistriOptimizer(Optimizer):
 
         repl = replicated(mesh)
         batch_shard = data_sharding(mesh)
-        params = jax.device_put(params, repl)
+        param_shard, opt_shard = repl, repl
+        tp_tree = None
+        if self.tensor_parallel:
+            from bigdl_tpu.parallel.tensor_parallel import shard_params
+            tp_axis = (self.tensor_parallel
+                       if isinstance(self.tensor_parallel, str)
+                       else "model")
+            param_shard = tp_tree = shard_params(params, mesh, tp_axis)
+        if self.shard_optim_state:
+            # ZeRO-1 layout: each replica keeps 1/N of momentum/accums
+            # (composes with TP — the TP layout wins where present)
+            from bigdl_tpu.parallel.tensor_parallel import \
+                shard_optim_state_zero1
+            opt_shard = shard_optim_state_zero1(
+                opt_state, params, mesh, param_shardings=tp_tree)
+        elif tp_tree is not None:
+            from bigdl_tpu.parallel.tensor_parallel import \
+                sharding_for_tree_like
+            opt_shard = sharding_for_tree_like(opt_state, params,
+                                               tp_tree, repl)
+        params = jax.device_put(params, param_shard)
         mstate = jax.device_put(mstate, repl)
-        opt_state = jax.device_put(opt_state, repl)
+        opt_state = jax.device_put(opt_state, opt_shard)
 
         def train_step(params, mstate, opt_state, rng, data, labels, epoch):
             def loss_fn(p):
@@ -108,15 +133,15 @@ class DistriOptimizer(Optimizer):
         jit_step = jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
-            in_shardings=(repl, repl, repl, repl, batch_shard, batch_shard,
-                          None),
-            out_shardings=(repl, repl, repl, repl))
+            in_shardings=(param_shard, repl, opt_shard, repl, batch_shard,
+                          batch_shard, None),
+            out_shardings=(param_shard, repl, opt_shard, repl))
 
         def eval_apply(params, mstate, data):
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 
-        jit_eval = jax.jit(eval_apply, in_shardings=(repl, repl,
+        jit_eval = jax.jit(eval_apply, in_shardings=(param_shard, repl,
                                                      batch_shard),
                            out_shardings=batch_shard)
 

@@ -174,9 +174,18 @@ class Optimizer:
         full_state = dict(driver_state)
         full_state["record_count"] = record_count
         full_state["batches_this_epoch"] = batches_this_epoch
+        def to_host(v):
+            # sharded leaves (ZeRO-1 / tensor-parallel layouts) spanning
+            # several processes are not addressable for a plain
+            # np.asarray — gather the full value first
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                return np.asarray(
+                    multihost_utils.process_allgather(v, tiled=True))
+            return np.asarray(v)
+
         if opt_state is not None:
-            full_state["opt_state"] = jax.tree.map(
-                lambda v: np.asarray(v), opt_state)
+            full_state["opt_state"] = jax.tree.map(to_host, opt_state)
         if rng is not None:
             full_state["rng"] = np.asarray(rng)
         # opaque bytes: the nested state dict (strings/ints/arrays) must

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: SPMD GPipe over a mesh axis.
+
+The reference has no pipeline parallelism (whole model per executor). The
+TPU-native construction (the scaling-book recipe): L IDENTICAL layers are
+stacked parameter-wise, the stack is sharded over the ``model`` axis so
+each device owns L/S consecutive layers, and microbatches stream through
+the stages with activations hopping stage-to-stage via ``ppermute``
+(neighbor ICI links). All devices run the same program — stage identity
+comes from ``lax.axis_index`` — so the whole thing jits as one SPMD
+computation and autodiff produces the reverse pipeline automatically.
+
+Homogeneity is the honest constraint: heterogeneous ``Sequential`` stages
+cannot ride one SPMD program. That matches where pipelining earns its keep
+(deep stacks of identical blocks).
+
+Schedule: GPipe-style fill-drain over T = M + S - 1 ticks for M
+microbatches and S stages; bubble fraction (S-1)/T shrinks as M grows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.parallel.collective import shard_map
+from bigdl_tpu.parallel.engine import get_mesh
+
+__all__ = ["pipeline_apply", "stack_layer_params"]
+
+
+def stack_layer_params(params_list):
+    """Stack per-layer param pytrees into one tree with a leading layer
+    axis (what ``pipeline_apply`` consumes and what gets sharded)."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *params_list)
+
+
+def _local_stack_apply(layer_apply, local_params, x):
+    """Run this stage's L/S stacked layers in sequence via lax.scan."""
+
+    def body(h, layer_p):
+        return layer_apply(layer_p, h), None
+
+    y, _ = jax.lax.scan(body, x, local_params)
+    return y
+
+
+def pipeline_apply(layer_apply, stacked_params, x, *,
+                   num_microbatches: int, axis: str = "model",
+                   mesh: Mesh | None = None):
+    """Apply L stacked identical layers to ``x`` through an S-stage
+    pipeline over mesh ``axis``.
+
+    ``layer_apply(layer_params, h) -> h`` is one layer's pure function;
+    ``stacked_params`` leaves have leading dim L (see
+    ``stack_layer_params``); L must divide by the axis size S, the batch
+    by ``num_microbatches``. Differentiable end-to-end; returns the same
+    result as serially applying the L layers (up to float order).
+    """
+    mesh = mesh or get_mesh()
+    s = mesh.shape[axis]
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % s:
+        raise ValueError(f"{n_layers} layers not divisible by "
+                         f"{s} pipeline stages")
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"{num_microbatches} microbatches")
+    mb = batch // num_microbatches
+    m = num_microbatches
+
+    def body(local_params, xb):
+        # local_params leaves: (L/S, ...) — this stage's layer block
+        stage = jax.lax.axis_index(axis)
+        mbs = xb.reshape((m, mb) + xb.shape[1:])
+        perm = [(i, (i + 1) % s) for i in range(s)]  # downstream hop
+        carry = jnp.zeros_like(mbs[0])
+        out = jnp.zeros_like(mbs)
+        for t in range(m + s - 1):
+            # stage 0 injects microbatch t; others take the upstream hop
+            feed = mbs[min(t, m - 1)]
+            h = jnp.where(stage == 0, feed, carry)
+            y = _local_stack_apply(layer_apply, local_params, h)
+            # the LAST stage finished microbatch t-(s-1) this tick
+            oi = t - (s - 1)
+            if oi >= 0:
+                valid = stage == (s - 1)
+                out = out.at[oi].set(jnp.where(valid, y, out[oi]))
+            if t != m + s - 2:
+                carry = jax.lax.ppermute(y, axis, perm)
+        # outputs are populated only on the last stage; psum replicates
+        # them (zeros elsewhere keep the sum exact)
+        out = jax.lax.psum(out, axis)
+        return out.reshape((batch,) + out.shape[2:])
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_rep=False)(stacked_params, x)

@@ -1,0 +1,101 @@
+"""Tensor parallelism over the mesh ``model`` axis, the GSPMD way.
+
+The reference has no tensor parallelism (its model lives whole on every
+executor). On TPU the idiomatic construction is NOT hand-written
+column/row-parallel layers with explicit collectives — it is layout
+annotation: store each parameter sharded over the ``model`` axis and let
+XLA's SPMD partitioner split the matmuls/convs and insert the collectives
+(the "pick a mesh, annotate shardings, let XLA do the rest" recipe).
+Math is unchanged by construction; only layout and communication differ.
+
+``shard_params`` classifies a params pytree into per-leaf
+``NamedSharding``s:
+
+- Linear-like (out, in) 2-D weights  -> P(axis, None)   (column parallel)
+- Conv OIHW 4-D weights              -> P(axis)         (output channels)
+- 1-D biases/affine whose length matches a sharded out-dim -> P(axis)
+- everything else (BN stats, scalars, indivisible dims) -> replicated
+
+A dim that does not divide the axis size falls back to replicated —
+correctness never depends on divisibility.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.engine import get_mesh
+
+__all__ = ["shard_params", "sharding_for_tree_like"]
+
+
+def _leaf_spec(leaf, n: int, axis: str) -> P:
+    shape = getattr(leaf, "shape", ())
+    if len(shape) == 2 and shape[0] % n == 0:
+        return P(axis, None)          # (out, in) — column parallel
+    if len(shape) == 4 and shape[0] % n == 0:
+        return P(axis)                # OIHW — shard output channels
+    if len(shape) == 1 and shape[0] % n == 0 and shape[0] >= n:
+        return P(axis)                # bias/affine along the out dim
+    return P()
+
+
+def shard_params(params, mesh: Mesh | None = None, axis: str = "model"):
+    """Per-leaf NamedSharding tree for tensor-parallel parameter layout."""
+    mesh = mesh or get_mesh()
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    return jax.tree.map(
+        lambda l: NamedSharding(mesh, _leaf_spec(l, n, axis)), params)
+
+
+def sharding_for_tree_like(tree, params, param_shardings, default):
+    """Extend a params sharding tree onto a params-SHAPED subtree holder
+    (optimizer state): any top-level value whose tree structure matches
+    ``params`` gets ``param_shardings``; everything else ``default``."""
+    pstruct = jax.tree.structure(params)
+    out = {}
+    for key, val in tree.items():
+        if jax.tree.structure(val) == pstruct:
+            out[key] = param_shardings
+        else:
+            out[key] = jax.tree.map(lambda _: default, val)
+    return out
+
+
+def shard_optim_state_zero1(opt_state, params, mesh: Mesh | None = None,
+                            axis: str = "data", param_shardings=None):
+    """ZeRO-1-style layout for optimizer state: params-shaped subtrees
+    (momentum, Adagrad accumulators) sharded along dim 0 over the data
+    axis, so each replica stores 1/N of them (the reference's per-slice
+    SGD-state ownership, DistriOptimizer.scala:231-232). Leaves whose dim
+    0 does not divide — or that already carry a tensor-parallel spec in
+    ``param_shardings`` — keep that layout instead."""
+    mesh = mesh or get_mesh()
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{axis}' axis: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def leaf_sharding(leaf, existing):
+        if existing is not None and existing.spec != P():
+            return existing              # TP layout wins where present
+        shape = getattr(leaf, "shape", ())
+        if shape and shape[0] % n == 0 and shape[0] >= n:
+            return NamedSharding(mesh, P(axis))
+        return repl
+
+    pstruct = jax.tree.structure(params)
+    out = {}
+    for key, val in opt_state.items():
+        if jax.tree.structure(val) == pstruct:
+            if param_shardings is not None:
+                out[key] = jax.tree.map(leaf_sharding, val, param_shardings)
+            else:
+                out[key] = jax.tree.map(
+                    lambda l: leaf_sharding(l, None), val)
+        else:
+            out[key] = jax.tree.map(lambda _: repl, val)
+    return out

@@ -21,7 +21,8 @@ import numpy as np  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-from tests.golden.spec import MODEL_SPECS, build, fixture_path  # noqa: E402
+from tests.golden.spec import (MODEL_SPECS, build, fixture_path,  # noqa: E402
+                               param_abs_sum)
 
 
 def main():
@@ -29,9 +30,7 @@ def main():
         model, x = build(name)
         y, _ = model.apply(model.params, model.state, x)
         out = np.asarray(y, np.float32)
-        leaves = jax.tree.leaves(model.params)
-        param_sum = float(sum(np.abs(np.asarray(l, np.float64)).sum()
-                              for l in leaves))
+        param_sum = param_abs_sum(model.params)
         np.savez(fixture_path(name), output=out,
                  param_abs_sum=np.float64(param_sum))
         print(f"{name}: out{out.shape} sum|p|={param_sum:.6f}")

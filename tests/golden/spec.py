@@ -38,3 +38,11 @@ def build(name):
     model.evaluate()
     x = np.random.default_rng(42).standard_normal(shape).astype(np.float32)
     return model, x
+
+
+def param_abs_sum(params) -> float:
+    """The single definition both generator and test compare against."""
+    import jax
+    leaves = jax.tree.leaves(params)
+    return float(sum(np.abs(np.asarray(l, np.float64)).sum()
+                     for l in leaves))

@@ -12,7 +12,8 @@ import os
 import numpy as np
 import pytest
 
-from tests.golden.spec import MODEL_SPECS, build, fixture_path
+from tests.golden.spec import (MODEL_SPECS, build, fixture_path,
+                               param_abs_sum)
 
 
 @pytest.mark.parametrize("name", sorted(MODEL_SPECS))
@@ -22,13 +23,9 @@ def test_model_matches_golden_fixture(name):
         f"missing fixture {path} — run tests/golden/generate.py"
     fx = np.load(path)
     model, x = build(name)
-    import jax
-    leaves = jax.tree.leaves(model.params)
-    param_sum = float(sum(np.abs(np.asarray(l, np.float64)).sum()
-                          for l in leaves))
     # init determinism: the summed |params| is seed- and order-stable
-    np.testing.assert_allclose(param_sum, float(fx["param_abs_sum"]),
-                               rtol=1e-9)
+    np.testing.assert_allclose(param_abs_sum(model.params),
+                               float(fx["param_abs_sum"]), rtol=1e-9)
     y, _ = model.apply(model.params, model.state, x)
     # forward reproducibility: loose enough to survive XLA re-fusions,
     # tight enough to catch any real math change

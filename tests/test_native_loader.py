@@ -145,3 +145,22 @@ class TestNativeBatchTransformer:
         np.testing.assert_array_equal(a, b)
         c = list(t(read_records(str(p))))[0].data   # stream advanced
         assert not np.array_equal(a, c)
+
+    def test_eval_pipeline_leaves_host_rng_untouched(self, tmp_path):
+        """Validation passes run between checkpoints; they must not
+        advance the checkpointed train-augmentation stream (review
+        finding: exact resume would silently diverge)."""
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.recordio import RecordWriter, read_records
+        from bigdl_tpu.utils.random import RandomGenerator
+        p = tmp_path / "s.brec"
+        with RecordWriter(str(p)) as w:
+            for i in range(4):
+                w.write(_jpeg(seed=i), float(i + 1))
+        RandomGenerator.seed_thread(99)
+        probe_before = RandomGenerator.RNG()._rng.bit_generator.state
+        t = NativeBRecToBatch(4, 24, 24, train=False, mean_rgb=MEAN_RGB,
+                              std_rgb=STD_RGB)
+        list(t(read_records(str(p))))
+        probe_after = RandomGenerator.RNG()._rng.bit_generator.state
+        assert str(probe_before) == str(probe_after)

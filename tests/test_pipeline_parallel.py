@@ -1,0 +1,107 @@
+"""SPMD pipeline parallelism tests (parallel/pipeline.py) on the
+8-virtual-device CPU mesh: pipelined == serial, values and gradients."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.parallel.engine import Engine
+from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_layer_params
+
+
+def _layer_apply(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _make(n_layers=8, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    layers = [{"w": jnp.asarray((rng.standard_normal((d, d))
+                                 / np.sqrt(d)).astype(np.float32)),
+               "b": jnp.asarray(rng.standard_normal(d).astype(np.float32)
+                                * 0.1)}
+              for _ in range(n_layers)]
+    return stack_layer_params(layers), layers
+
+
+def _serial(layers, x):
+    h = x
+    for p in layers:
+        h = _layer_apply(p, h)
+    return h
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("stages,micro", [(4, 4), (8, 8), (2, 8)])
+    def test_matches_serial(self, stages, micro):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": stages},
+                           devices=jax.devices()[:stages])
+        stacked, layers = _make()
+        x = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((16, 16)).astype(np.float32))
+        y = pipeline_apply(_layer_apply, stacked, x,
+                           num_microbatches=micro, mesh=mesh)
+        ref = _serial(layers, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        Engine.reset()
+
+    def test_gradients_match_serial(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": 4}, devices=jax.devices()[:4])
+        stacked, layers = _make()
+        x = jnp.asarray(np.random.default_rng(2)
+                        .standard_normal((8, 16)).astype(np.float32))
+
+        def loss_pipe(sp):
+            return jnp.sum(pipeline_apply(_layer_apply, sp, x,
+                                          num_microbatches=4,
+                                          mesh=mesh) ** 2)
+
+        def loss_serial(sp):
+            h = x
+            def body(h, p):
+                return _layer_apply(p, h), None
+            h, _ = jax.lax.scan(body, h, sp)
+            return jnp.sum(h ** 2)
+
+        gp = jax.grad(loss_pipe)(stacked)
+        gs = jax.grad(loss_serial)(stacked)
+        for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+        Engine.reset()
+
+    def test_jits_and_trains(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": 4}, devices=jax.devices()[:4])
+        stacked, _ = _make()
+        x = jnp.asarray(np.random.default_rng(3)
+                        .standard_normal((8, 16)).astype(np.float32))
+        t = jnp.asarray(np.random.default_rng(4)
+                        .standard_normal((8, 16)).astype(np.float32))
+
+        @jax.jit
+        def step(sp):
+            def loss(sp):
+                y = pipeline_apply(_layer_apply, sp, x,
+                                   num_microbatches=4, mesh=mesh)
+                return jnp.mean((y - t) ** 2)
+            l, g = jax.value_and_grad(loss)(sp)
+            return l, jax.tree.map(lambda w, gw: w - 0.1 * gw, sp, g)
+
+        l0, stacked = step(stacked)
+        for _ in range(5):
+            l, stacked = step(stacked)
+        assert float(l) < float(l0)
+        Engine.reset()
+
+    def test_rejects_indivisible(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": 4}, devices=jax.devices()[:4])
+        stacked, _ = _make(n_layers=6)
+        x = jnp.zeros((8, 16), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(_layer_apply, stacked, x, num_microbatches=4,
+                           mesh=mesh)
+        Engine.reset()

@@ -1,0 +1,135 @@
+"""Tensor parallelism (GSPMD param sharding over the 'model' axis).
+
+The contract: layout-only — a tensor-parallel run must produce the SAME
+trained parameters as a replicated run, while the params actually live
+sharded on the mesh."""
+import numpy as np
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import dataset as ds
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import SGD, max_iteration
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.engine import Engine
+from bigdl_tpu.parallel.tensor_parallel import shard_params
+
+
+def _mlp():
+    return (nn.Sequential()
+            .add(nn.Linear(64, 128)).add(nn.ReLU())
+            .add(nn.Linear(128, 8)).add(nn.LogSoftMax()))
+
+
+def _cnn():
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1))
+            .add(nn.ReLU())
+            .add(nn.SpatialBatchNormalization(16))
+            .add(nn.View(16 * 8 * 8))
+            .add(nn.Linear(16 * 8 * 8, 8)).add(nn.LogSoftMax()))
+
+
+def _train(make_model, data_shape, tp):
+    Engine.reset()
+    mesh = Engine.init(axes={"data": 2, "model": 4})
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal(data_shape).astype(np.float32)
+    labels = rng.integers(1, 9, size=(data_shape[0],))
+    batches = [MiniBatch(data, labels)]
+    model = make_model()
+    opt = DistriOptimizer(
+        model, ds.iterator_source(lambda: iter(batches),
+                                  size=data_shape[0]),
+        nn.ClassNLLCriterion(), mesh=mesh, tensor_parallel=tp)
+    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(3))
+    trained = opt.optimize()
+    Engine.reset()
+    return trained
+
+
+@pytest.mark.parametrize("make_model,shape", [(_mlp, (16, 64)),
+                                              (_cnn, (16, 3, 8, 8))])
+def test_tp_trains_identically_to_replicated(make_model, shape):
+    p_repl = jax.tree.map(np.asarray, _train(make_model, shape, False)
+                          .params)
+    p_tp = jax.tree.map(np.asarray, _train(make_model, shape, True).params)
+    for a, b in zip(jax.tree.leaves(p_repl), jax.tree.leaves(p_tp)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_shard_params_rules():
+    Engine.reset()
+    mesh = Engine.init(axes={"model": 4}, devices=jax.devices()[:4])
+    model = _cnn()
+    model.materialize(jax.random.PRNGKey(0))
+    sh = shard_params(model.params, mesh)
+    # conv OIHW (16,3,3,3): O sharded; BN affine (16,): sharded;
+    # linear (8, 1024): column parallel
+    assert sh["0"]["weight"].spec == P("model")
+    assert sh["2"]["weight"].spec == P("model")
+    assert sh["4"]["weight"].spec == P("model", None)
+    # conv bias (16,) divides 4 -> sharded along out
+    assert sh["0"]["bias"].spec == P("model")
+    Engine.reset()
+
+
+def test_tp_params_actually_sharded():
+    Engine.reset()
+    mesh = Engine.init(axes={"data": 2, "model": 4})
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((16, 64)).astype(np.float32)
+    labels = rng.integers(1, 9, size=(16,))
+    model = _mlp()
+    opt = DistriOptimizer(
+        model, ds.iterator_source(lambda: iter([MiniBatch(data, labels)]),
+                                  size=16),
+        nn.ClassNLLCriterion(), mesh=mesh, tensor_parallel=True)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(1))
+    trained = opt.optimize()
+    # first Linear weight (128, 64): P('model', None)
+    w = trained.params["0"]["weight"]
+    assert w.sharding.spec == P("model", None), w.sharding
+    Engine.reset()
+
+
+def test_zero1_layout_shards_momentum():
+    from bigdl_tpu.parallel.tensor_parallel import shard_optim_state_zero1
+    Engine.reset()
+    mesh = Engine.init(axes={"data": 8})
+    model = _mlp()
+    model.materialize(jax.random.PRNGKey(0))
+    sgd = SGD(learning_rate=0.1, momentum=0.9)
+    opt_state = sgd.init_state(model.params)
+    sh = shard_optim_state_zero1(opt_state, model.params, mesh)
+    # momentum for Linear (128, 64): dim 0 divides 8 -> sharded
+    assert sh["velocity"]["0"]["weight"].spec == P("data")
+    # scalars stay replicated
+    assert sh["neval"].spec == P()
+    Engine.reset()
+
+
+def test_zero1_trains_identically_to_replicated():
+    def run(zero1):
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 8})
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((16, 64)).astype(np.float32)
+        labels = rng.integers(1, 9, size=(16,))
+        model = _mlp()
+        opt = DistriOptimizer(
+            model, ds.iterator_source(
+                lambda: iter([MiniBatch(data, labels)]), size=16),
+            nn.ClassNLLCriterion(), mesh=mesh, shard_optim_state=zero1)
+        opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+        opt.set_end_when(max_iteration(3))
+        trained = opt.optimize()
+        Engine.reset()
+        return jax.tree.map(np.asarray, trained.params)
+
+    for a, b in zip(jax.tree.leaves(run(False)), jax.tree.leaves(run(True))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
