@@ -110,9 +110,12 @@ class NativeBRecToBatch(Transformer):
             # pass would advance it past what resume replays) — a local
             # counter still varies per batch for flip_prob>0 eval setups.
             if not self.train:
+                # distinct mixing constant from seed_worker's (so eval
+                # streams never collide with train worker streams in the
+                # same process)
                 eval_counter[0] += 1
-                return RandomGenerator._default_seed + 0x9E3779B1 \
-                    * eval_counter[0]
+                return (RandomGenerator._default_seed
+                        + 0x27D4EB2F * eval_counter[0] + 0x165667B1)
             return int(RandomGenerator.RNG().random_int(0, 2 ** 63))
 
         with ThreadPoolExecutor(max_workers=1) as pool:

@@ -174,18 +174,9 @@ class Optimizer:
         full_state = dict(driver_state)
         full_state["record_count"] = record_count
         full_state["batches_this_epoch"] = batches_this_epoch
-        def to_host(v):
-            # sharded leaves (ZeRO-1 / tensor-parallel layouts) spanning
-            # several processes are not addressable for a plain
-            # np.asarray — gather the full value first
-            if isinstance(v, jax.Array) and not v.is_fully_addressable:
-                from jax.experimental import multihost_utils
-                return np.asarray(
-                    multihost_utils.process_allgather(v, tiled=True))
-            return np.asarray(v)
-
         if opt_state is not None:
-            full_state["opt_state"] = jax.tree.map(to_host, opt_state)
+            # _file._to_host gathers non-addressable (sharded) leaves
+            full_state["opt_state"] = _file._to_host(opt_state)
         if rng is not None:
             full_state["rng"] = np.asarray(rng)
         # opaque bytes: the nested state dict (strings/ints/arrays) must

@@ -8,3 +8,4 @@ from bigdl_tpu.parallel.sequence import (dot_product_attention,
                                          ring_attention_sharded,
                                          ulysses_attention)
 from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_layer_params
+from bigdl_tpu.parallel.expert import moe_apply

@@ -1,0 +1,103 @@
+"""Expert parallelism: mixture-of-experts with all_to_all dispatch.
+
+The reference's closest ancestor is ``MixtureTable`` (nn/MixtureTable.scala
+— dense gating over experts that all live everywhere). Expert parallelism
+is the TPU-scale version: each mesh shard OWNS one expert's parameters,
+tokens are routed top-1 by a learned gate, hop to their expert's device
+with one ``all_to_all``, run the expert, and hop back. Capacity-based
+dispatch (fixed C slots per expert) keeps every shape static for XLA;
+overflow tokens pass through unchanged (standard MoE practice).
+
+Functional and differentiable end-to-end: the gate receives gradients
+through the combine weights, experts through their tokens.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.parallel.collective import shard_map
+from bigdl_tpu.parallel.engine import get_mesh
+
+__all__ = ["moe_apply"]
+
+
+def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
+              capacity_factor: float = 1.25, axis: str = "model",
+              mesh: Mesh | None = None):
+    """Top-1 mixture of experts over mesh ``axis`` (one expert per shard).
+
+    - ``expert_apply(expert_params, tokens) -> tokens``: one expert's pure
+      function over (n, d) tokens.
+    - ``stacked_expert_params``: leaves with leading dim E == axis size
+      (expert e's params live on shard e).
+    - ``x``: (tokens, d), sharded over ``axis`` (each shard's local
+      tokens); ``gate_w``: (d, E) replicated.
+
+    Returns (y, aux_loss) — y shaped like x; aux_loss is the standard
+    load-balancing loss (mean_e fraction_e * prob_e * E).
+    """
+    mesh = mesh or get_mesh()
+    e = mesh.shape[axis]
+    n_exp = jax.tree.leaves(stacked_expert_params)[0].shape[0]
+    if n_exp != e:
+        raise ValueError(f"{n_exp} experts != mesh axis '{axis}' size {e}")
+    if x.shape[0] % e:
+        raise ValueError(f"tokens {x.shape[0]} not divisible by {e} shards")
+    t_local = x.shape[0] // e
+    cap = max(1, int(t_local * capacity_factor / e))
+
+    def body(expert_params, xb, gw):
+        # xb: (t_local, d) — this shard's tokens
+        f32 = jnp.float32
+        logits = (xb.astype(f32) @ gw.astype(f32))            # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top = jnp.argmax(probs, axis=-1)                      # (T,)
+        top_p = jnp.take_along_axis(probs, top[:, None], 1)[:, 0]
+
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(top, e, dtype=f32)            # (T, E)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (T, E)
+        in_cap = (pos < cap) & (onehot > 0)                   # (T, E)
+        kept = jnp.any(in_cap, axis=-1)                       # (T,)
+
+        # dispatch tensor (E, C, d): token t -> slot (top_t, pos_t)
+        slot = jnp.where(in_cap, pos, 0.0).sum(axis=-1).astype(jnp.int32)
+        disp = jnp.zeros((e, cap, xb.shape[1]), xb.dtype)
+        disp = disp.at[top, slot].add(
+            jnp.where(kept[:, None], xb, 0).astype(xb.dtype))
+
+        # to experts: all_to_all over the expert dim — shard i receives
+        # (E, C, d) where dim 0 is the SOURCE shard, all for expert i
+        recv = jax.lax.all_to_all(disp, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        yexp = expert_apply(
+            jax.tree.map(lambda l: l[0], expert_params),
+            recv.reshape(e * cap, xb.shape[1]))
+        # back to sources (inverse all_to_all)
+        back = jax.lax.all_to_all(yexp.reshape(e, cap, xb.shape[1]),
+                                  axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+        # combine: gather each kept token's slot, weight by its gate prob;
+        # overflow tokens pass through
+        gathered = back[top, slot]                            # (T, d)
+        y = jnp.where(kept[:, None],
+                      gathered.astype(f32) * top_p[:, None],
+                      xb.astype(f32)).astype(xb.dtype)
+
+        # load-balancing loss (Shazeer-style): E * sum_e f_e * p_e
+        frac = jnp.mean(onehot, axis=0)
+        mean_p = jnp.mean(probs, axis=0)
+        aux = jnp.sum(frac * mean_p) * e
+        aux = jax.lax.pmean(aux, axis)
+        return y, aux
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_expert_params)
+    y, aux = shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, P(axis), P()),
+        out_specs=(P(axis), P()),
+        check_rep=False)(stacked_expert_params, x, gate_w)
+    return y, aux

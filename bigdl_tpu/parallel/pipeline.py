@@ -74,20 +74,27 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
         stage = jax.lax.axis_index(axis)
         mbs = xb.reshape((m, mb) + xb.shape[1:])
         perm = [(i, (i + 1) % s) for i in range(s)]  # downstream hop
-        carry = jnp.zeros_like(mbs[0])
-        out = jnp.zeros_like(mbs)
-        for t in range(m + s - 1):
+
+        def tick(state, t):
+            # lax.scan keeps the program size constant in M and S —
+            # a Python unroll doubled the jaxpr per extra microbatch
+            carry, out = state
             # stage 0 injects microbatch t; others take the upstream hop
-            feed = mbs[min(t, m - 1)]
+            feed = jnp.take(mbs, jnp.minimum(t, m - 1), axis=0)
             h = jnp.where(stage == 0, feed, carry)
             y = _local_stack_apply(layer_apply, local_params, h)
             # the LAST stage finished microbatch t-(s-1) this tick
             oi = t - (s - 1)
-            if oi >= 0:
-                valid = stage == (s - 1)
-                out = out.at[oi].set(jnp.where(valid, y, out[oi]))
-            if t != m + s - 2:
-                carry = jax.lax.ppermute(y, axis, perm)
+            valid = (stage == (s - 1)) & (oi >= 0)
+            slot = jnp.clip(oi, 0, m - 1)
+            out = out.at[slot].set(
+                jnp.where(valid, y, jnp.take(out, slot, axis=0)))
+            carry = jax.lax.ppermute(y, axis, perm)
+            return (carry, out), None
+
+        init = (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
+        (_, out), _ = jax.lax.scan(tick, init,
+                                   jnp.arange(m + s - 1))
         # outputs are populated only on the last stage; psum replicates
         # them (zeros elsewhere keep the sum exact)
         out = jax.lax.psum(out, axis)

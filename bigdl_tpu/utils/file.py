@@ -23,9 +23,21 @@ _MAGIC = "bigdl_tpu.v1"
 
 
 def _to_host(obj):
-    """Replace jax arrays with numpy arrays throughout a pytree/object."""
-    return jax.tree.map(
-        lambda v: np.asarray(v) if hasattr(v, "__array__") else v, obj)
+    """Replace jax arrays with numpy arrays throughout a pytree/object.
+
+    Sharded leaves spanning several processes (tensor-parallel params,
+    ZeRO-1 optimizer state) are not addressable for a plain np.asarray —
+    gather the full value first so checkpoints always hold global
+    arrays."""
+
+    def leaf(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                v, tiled=True))
+        return np.asarray(v) if hasattr(v, "__array__") else v
+
+    return jax.tree.map(leaf, obj)
 
 
 def save(obj, path: str, overwrite: bool = False) -> None:
