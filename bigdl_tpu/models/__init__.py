@@ -11,6 +11,8 @@ from bigdl_tpu.models.vgg.model import VggForCifar10, Vgg_16, Vgg_19
 from bigdl_tpu.models.resnet.model import (ResNet, ShortcutType, DatasetType,
                                      model_init)
 from bigdl_tpu.models.rnn.model import SimpleRNN, BatchedSimpleRNN
+from bigdl_tpu.models.transformer.model import (TransformerBlock,
+                                                TransformerLM)
 
 __all__ = [
     "LeNet5", "AlexNet", "AlexNet_OWT", "Autoencoder",
@@ -19,4 +21,5 @@ __all__ = [
     "VggForCifar10", "Vgg_16", "Vgg_19",
     "ResNet", "ShortcutType", "DatasetType", "model_init",
     "SimpleRNN", "BatchedSimpleRNN",
+    "TransformerLM", "TransformerBlock",
 ]

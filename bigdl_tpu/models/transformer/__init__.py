@@ -1,0 +1,4 @@
+from bigdl_tpu.models.transformer.model import (TransformerBlock,
+                                                TransformerLM)
+
+__all__ = ["TransformerLM", "TransformerBlock"]

@@ -1,0 +1,97 @@
+"""Transformer language model — the long-context flagship.
+
+Beyond the reference's scope (its era ends at scan RNNs, SURVEY §5.7),
+but the capability target this framework treats as first-class: a causal
+decoder whose attention core can run locally, ring-parallel, or
+Ulysses-parallel over the mesh ``seq`` axis (nn/attention.py +
+parallel/sequence.py) without touching the parameters. Pre-LN blocks,
+learned positional embeddings, weight-tied-free output head.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import Container, Module
+from bigdl_tpu.tensor import activation_dtype, default_dtype
+
+__all__ = ["TransformerLM", "TransformerBlock"]
+
+
+class _Residual(Container):
+    """y = x + inner(norm(x)) — pre-LN residual wrapper."""
+
+    def __init__(self, d_model: int, inner: Module):
+        super().__init__(nn.LayerNorm(d_model), inner)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h, s0 = self.modules[0].apply(params["0"], state["0"], x,
+                                      training=training)
+        h, s1 = self.modules[1].apply(params["1"], state["1"], h,
+                                      training=training, rng=rng)
+        return x + h, {"0": s0, "1": s1}
+
+
+def TransformerBlock(d_model: int, num_heads: int, ffn_mult: int = 4,
+                     dropout: float = 0.0,
+                     sequence_parallel: str | None = None):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    mha = nn.MultiHeadAttention(d_model, num_heads, causal=True,
+                                sequence_parallel=sequence_parallel)
+    ffn = (nn.Sequential()
+           .add(nn.Linear(d_model, ffn_mult * d_model))
+           .add(nn.ReLU())
+           .add(nn.Linear(ffn_mult * d_model, d_model)))
+    if dropout > 0:
+        ffn.add(nn.Dropout(dropout))
+    return (nn.Sequential()
+            .add(_Residual(d_model, mha))
+            .add(_Residual(d_model, ffn)))
+
+
+class _TokenAndPosition(Module):
+    """LookupTable embedding + learned positional embedding."""
+
+    def __init__(self, vocab: int, d_model: int, max_len: int):
+        super().__init__()
+        self.vocab, self.d_model, self.max_len = vocab, d_model, max_len
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        scale = 1.0 / np.sqrt(self.d_model)
+        return {"tok": jax.random.normal(
+                    k1, (self.vocab, self.d_model),
+                    default_dtype()) * scale,
+                "pos": jax.random.normal(
+                    k2, (self.max_len, self.d_model),
+                    default_dtype()) * scale}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # x: (batch, seq) 1-based token ids (LookupTable convention)
+        idx = x.astype(jnp.int32) - 1
+        s = x.shape[1]
+        y = jnp.take(params["tok"], jnp.clip(idx, 0, self.vocab - 1),
+                     axis=0) + params["pos"][:s]
+        return y.astype(activation_dtype()), state
+
+
+def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
+                  num_layers: int = 2, max_len: int = 512,
+                  ffn_mult: int = 4, dropout: float = 0.0,
+                  sequence_parallel: str | None = None) -> nn.Sequential:
+    """Causal LM: tokens (B, S) -> log-probs (B, S, vocab)."""
+    model = (nn.Sequential()
+             .add(_TokenAndPosition(vocab_size, d_model, max_len)
+                  .set_name("embed")))
+    for i in range(num_layers):
+        model.add(TransformerBlock(
+            d_model, num_heads, ffn_mult, dropout,
+            sequence_parallel).set_name(f"block_{i}"))
+    model.add(nn.LayerNorm(d_model).set_name("final_norm"))
+    model.add(nn.Linear(d_model, vocab_size,
+                        init_method=init_mod.Xavier).set_name("lm_head"))
+    model.add(nn.LogSoftMax())
+    return model

@@ -1,0 +1,103 @@
+"""Transformer LM training main — the long-context counterpart of the
+SimpleRNN main (models/rnn/train.py): same text pipeline (tokenize, pad,
+dictionary-encode), causal next-token objective, but attention blocks
+that can shard the sequence over the mesh ``seq`` axis.
+
+Run: ``python -m bigdl_tpu.models.transformer.train -f <dir_with_input.txt>
+[--seqLength 128] [--sequenceParallel ring|ulysses]``.
+"""
+from __future__ import annotations
+
+import os
+
+from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_train_parser("Train a Transformer LM")
+    parser.add_argument("--vocabSize", type=int, default=4000)
+    parser.add_argument("--dModel", type=int, default=128)
+    parser.add_argument("--numHeads", type=int, default=4)
+    parser.add_argument("--numLayers", type=int, default=2)
+    parser.add_argument("--seqLength", type=int, default=128)
+    parser.add_argument("--dropout", type=float, default=0.0)
+    parser.add_argument("--sequenceParallel", default=None,
+                        choices=[None, "ring", "ulysses"])
+    args = parser.parse_args(argv)
+    mesh = init_engine(args.chips)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
+    from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                        SentenceBiPadding, SentenceSplitter,
+                                        SentenceTokenizer,
+                                        TextToLabeledSentence)
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import (Loss, Optimizer, SGD, every_epoch,
+                                 max_epoch)
+    from bigdl_tpu.utils import file as bfile
+
+    text_path = os.path.join(args.folder, "input.txt")
+    with open(text_path) as f:
+        text = f.read()
+    sentences = list(SentenceSplitter()(iter([text])))
+    tokens = list(SentenceTokenizer()(iter(sentences)))
+    tokens = list(SentenceBiPadding()(iter(tokens)))
+    dictionary = Dictionary(tokens, args.vocabSize)
+    dictionary.save(args.checkpoint or args.folder)
+    vocab = dictionary.get_vocab_size() + 1   # + OOV bucket
+
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.transformer import Transformer
+
+    class ToTokenIds(Transformer):
+        """0-based dictionary indices -> the 1-based ids LookupTable-style
+        embeddings consume (the RNN main feeds one-hots instead)."""
+
+        def __call__(self, it):
+            for s in it:
+                yield Sample(s.feature.astype("int32") + 1, s.label)
+
+    to_sample = (TextToLabeledSentence(dictionary)
+                 >> LabeledSentenceToSample(
+                     vocab, fixed_data_length=args.seqLength,
+                     fixed_label_length=args.seqLength, one_hot=False)
+                 >> ToTokenIds())
+    samples = list(to_sample(iter(tokens)))
+    split = max(1, int(len(samples) * 0.8))
+    batch = args.batchSize or 32
+    train_set = LocalArrayDataSet(samples[:split]) >> SampleToBatch(
+        batch, drop_remainder=True)
+    val_set = LocalArrayDataSet(samples[split:] or samples[:1]) \
+        >> SampleToBatch(batch)
+
+    model = (bfile.load_module(args.model) if args.model
+             else TransformerLM(vocab, d_model=args.dModel,
+                                num_heads=args.numHeads,
+                                num_layers=args.numLayers,
+                                max_len=args.seqLength,
+                                dropout=args.dropout,
+                                sequence_parallel=args.sequenceParallel))
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    optimizer = Optimizer(model, train_set, criterion, mesh=mesh)
+    optimizer.set_optim_method(SGD(
+        learning_rate=args.learningRate or 0.02,
+        learning_rate_decay=0.001))
+    if args.state:
+        optimizer.set_state(bfile.load(args.state))
+    optimizer.set_validation(every_epoch(), val_set,
+                             [Loss(criterion.clone_criterion())])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+        if args.overWrite:
+            optimizer.overwrite_checkpoint()
+    optimizer.set_end_when(max_epoch(args.maxEpoch or 10))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN,
     Normalize, SpatialDivisiveNormalization, SpatialSubtractiveNormalization,
-    SpatialContrastiveNormalization)
+    SpatialContrastiveNormalization, LayerNorm)
 from bigdl_tpu.nn.dropout import Dropout, L1Penalty
 from bigdl_tpu.nn.structural import (
     Reshape, InferReshape, View, Transpose, Squeeze, Unsqueeze, Select,

@@ -22,7 +22,7 @@ from bigdl_tpu.ops import pow_neg_beta as _pow_neg_beta
 from bigdl_tpu.tensor import default_dtype
 
 __all__ = ["BatchNormalization", "SpatialBatchNormalization",
-           "SpatialCrossMapLRN", "Normalize",
+           "SpatialCrossMapLRN", "Normalize", "LayerNorm",
            "SpatialDivisiveNormalization", "SpatialSubtractiveNormalization",
            "SpatialContrastiveNormalization"]
 
@@ -287,3 +287,33 @@ class SpatialContrastiveNormalization(Module):
         y, _ = self.sub.apply({}, {}, x, training=training)
         y, _ = self.div.apply({}, {}, y, training=training)
         return y, state
+
+
+class LayerNorm(Module):
+    """Per-sample normalization over the trailing feature axis.
+
+    Not in the reference (its era normalized with BatchNorm only); carried
+    as the TPU-era extension the transformer stack (nn/attention.py,
+    models/transformer) requires. Statistics in f32 like BatchNorm."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 affine: bool = True):
+        super().__init__()
+        self.n_output, self.eps, self.affine = n_output, eps, affine
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        return {"weight": jnp.ones((self.n_output,), default_dtype()),
+                "bias": jnp.zeros((self.n_output,), default_dtype())}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        f32 = jnp.promote_types(x.dtype, jnp.float32)
+        xs = x.astype(f32)
+        mean = jnp.mean(xs, axis=-1, keepdims=True)
+        var = jnp.var(xs, axis=-1, keepdims=True)
+        y = (xs - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["weight"].astype(f32) \
+                + params["bias"].astype(f32)
+        return y.astype(x.dtype), state

@@ -45,8 +45,12 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
         raise ValueError(f"{n_exp} experts != mesh axis '{axis}' size {e}")
     if x.shape[0] % e:
         raise ValueError(f"tokens {x.shape[0]} not divisible by {e} shards")
+    if gate_w.shape[-1] != e:
+        raise ValueError(f"gate has {gate_w.shape[-1]} outputs for {e} "
+                         "experts")
     t_local = x.shape[0] // e
-    cap = max(1, int(t_local * capacity_factor / e))
+    # ceil: the requested headroom must survive small tokens-per-expert
+    cap = max(1, -(-int(t_local * capacity_factor) // e))
 
     def body(expert_params, xb, gw):
         # xb: (t_local, d) — this shard's tokens
