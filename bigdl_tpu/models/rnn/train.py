@@ -9,7 +9,6 @@ sees real batches instead of the reference's batch-1 worst case.
 """
 from __future__ import annotations
 
-import os
 
 from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
                                         setup_logging)
@@ -25,36 +24,15 @@ def main(argv=None):
     mesh = init_engine(args.chips)
 
     from bigdl_tpu import nn
-    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
-    from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
-                                        SentenceBiPadding, SentenceSplitter,
-                                        SentenceTokenizer,
-                                        TextToLabeledSentence)
-    from bigdl_tpu.dataset.transformer import SampleToBatch
     from bigdl_tpu.models import BatchedSimpleRNN
+    from bigdl_tpu.models.utils.text_lm import build_text_lm_datasets
     from bigdl_tpu.optim import (Loss, Optimizer, SGD, every_epoch, max_epoch)
     from bigdl_tpu.utils import file as bfile
 
-    text_path = os.path.join(args.folder, "input.txt")
-    with open(text_path) as f:
-        text = f.read()
-    sentences = list(SentenceSplitter()(iter([text])))
-    tokens = list(SentenceTokenizer()(iter(sentences)))
-    tokens = list(SentenceBiPadding()(iter(tokens)))
-    dictionary = Dictionary(tokens, args.vocabSize)
-    dictionary.save(args.checkpoint or args.folder)
-    vocab = dictionary.get_vocab_size() + 1   # + OOV bucket
-
-    to_sample = TextToLabeledSentence(dictionary) >> LabeledSentenceToSample(
-        vocab, fixed_data_length=args.seqLength,
-        fixed_label_length=args.seqLength)
-    samples = list(to_sample(iter(tokens)))
-    split = max(1, int(len(samples) * 0.8))
     batch = args.batchSize or 32
-    train_set = LocalArrayDataSet(samples[:split]) >> SampleToBatch(
-        batch, drop_remainder=True)
-    val_set = LocalArrayDataSet(samples[split:] or samples[:1]) \
-        >> SampleToBatch(batch)
+    train_set, val_set, vocab, _ = build_text_lm_datasets(
+        args.folder, args.vocabSize, args.seqLength, batch,
+        one_hot=True, dictionary_dir=args.checkpoint)
 
     model = (bfile.load_module(args.model) if args.model
              else BatchedSimpleRNN(vocab, args.hiddenSize, vocab))

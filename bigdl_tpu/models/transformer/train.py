@@ -1,14 +1,14 @@
 """Transformer LM training main — the long-context counterpart of the
-SimpleRNN main (models/rnn/train.py): same text pipeline (tokenize, pad,
-dictionary-encode), causal next-token objective, but attention blocks
-that can shard the sequence over the mesh ``seq`` axis.
+SimpleRNN main (models/rnn/train.py): same text pipeline (shared in
+models/utils/text_lm.py), causal next-token objective, but attention
+blocks that can shard the sequence over the mesh ``seq`` axis.
 
 Run: ``python -m bigdl_tpu.models.transformer.train -f <dir_with_input.txt>
-[--seqLength 128] [--sequenceParallel ring|ulysses]``.
+[--seqLength 128] [--sequenceParallel ring|ulysses]``. With
+``--sequenceParallel`` the mesh is built as {data: 1, seq: n_chips} and
+``seqLength`` must divide the chip count.
 """
 from __future__ import annotations
-
-import os
 
 from bigdl_tpu.models.utils.cli import (base_train_parser, init_engine,
                                         setup_logging)
@@ -26,53 +26,29 @@ def main(argv=None):
     parser.add_argument("--sequenceParallel", default=None,
                         choices=[None, "ring", "ulysses"])
     args = parser.parse_args(argv)
-    mesh = init_engine(args.chips)
+
+    if args.sequenceParallel:
+        # ring/ulysses attention shards dim 1 over a 'seq' mesh axis —
+        # the default data-only mesh cannot carry it
+        import jax
+
+        from bigdl_tpu.parallel.engine import Engine
+        n = args.chips or jax.device_count()
+        mesh = Engine.init(axes={"data": 1, "seq": n})
+    else:
+        mesh = init_engine(args.chips)
 
     from bigdl_tpu import nn
-    from bigdl_tpu.dataset.dataset import LocalArrayDataSet
-    from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
-                                        SentenceBiPadding, SentenceSplitter,
-                                        SentenceTokenizer,
-                                        TextToLabeledSentence)
-    from bigdl_tpu.dataset.transformer import SampleToBatch
     from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.utils.text_lm import build_text_lm_datasets
     from bigdl_tpu.optim import (Loss, Optimizer, SGD, every_epoch,
                                  max_epoch)
     from bigdl_tpu.utils import file as bfile
 
-    text_path = os.path.join(args.folder, "input.txt")
-    with open(text_path) as f:
-        text = f.read()
-    sentences = list(SentenceSplitter()(iter([text])))
-    tokens = list(SentenceTokenizer()(iter(sentences)))
-    tokens = list(SentenceBiPadding()(iter(tokens)))
-    dictionary = Dictionary(tokens, args.vocabSize)
-    dictionary.save(args.checkpoint or args.folder)
-    vocab = dictionary.get_vocab_size() + 1   # + OOV bucket
-
-    from bigdl_tpu.dataset.sample import Sample
-    from bigdl_tpu.dataset.transformer import Transformer
-
-    class ToTokenIds(Transformer):
-        """0-based dictionary indices -> the 1-based ids LookupTable-style
-        embeddings consume (the RNN main feeds one-hots instead)."""
-
-        def __call__(self, it):
-            for s in it:
-                yield Sample(s.feature.astype("int32") + 1, s.label)
-
-    to_sample = (TextToLabeledSentence(dictionary)
-                 >> LabeledSentenceToSample(
-                     vocab, fixed_data_length=args.seqLength,
-                     fixed_label_length=args.seqLength, one_hot=False)
-                 >> ToTokenIds())
-    samples = list(to_sample(iter(tokens)))
-    split = max(1, int(len(samples) * 0.8))
     batch = args.batchSize or 32
-    train_set = LocalArrayDataSet(samples[:split]) >> SampleToBatch(
-        batch, drop_remainder=True)
-    val_set = LocalArrayDataSet(samples[split:] or samples[:1]) \
-        >> SampleToBatch(batch)
+    train_set, val_set, vocab, _ = build_text_lm_datasets(
+        args.folder, args.vocabSize, args.seqLength, batch,
+        one_hot=False, dictionary_dir=args.checkpoint)
 
     model = (bfile.load_module(args.model) if args.model
              else TransformerLM(vocab, d_model=args.dModel,

@@ -48,9 +48,10 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
     if gate_w.shape[-1] != e:
         raise ValueError(f"gate has {gate_w.shape[-1]} outputs for {e} "
                          "experts")
+    import math
     t_local = x.shape[0] // e
-    # ceil: the requested headroom must survive small tokens-per-expert
-    cap = max(1, -(-int(t_local * capacity_factor) // e))
+    # true ceil: fractional headroom must survive small tokens-per-expert
+    cap = max(1, math.ceil(t_local * capacity_factor / e))
 
     def body(expert_params, xb, gw):
         # xb: (t_local, d) — this shard's tokens

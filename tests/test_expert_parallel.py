@@ -56,7 +56,8 @@ class TestExpertParallel:
         Engine.reset()
         mesh = Engine.init(axes={"model": 8})
         stacked, experts, x, gate_w = _setup()
-        cap = max(1, -(-int(8 * 1.25) // 8))
+        import math
+        cap = max(1, math.ceil(8 * 1.25 / 8))
         y, aux = moe_apply(_expert_apply, stacked, x, gate_w,
                            capacity_factor=1.25, mesh=mesh)
         ref = _dense_reference(experts, x, gate_w, 8, cap)

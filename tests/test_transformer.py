@@ -90,3 +90,23 @@ class TestTransformerLM:
         np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_local),
                                    rtol=2e-4, atol=2e-4)
         Engine.reset()
+
+
+def test_train_main_with_sequence_parallel(tmp_path):
+    """The CLI's --sequenceParallel flag must build a seq-axis mesh and
+    train (review finding: the data-only mesh crashed ring attention)."""
+    import random
+
+    from bigdl_tpu.models.transformer.train import main
+    from bigdl_tpu.parallel.engine import Engine
+    random.seed(0)
+    words = ["a", "b", "c", "d", "e", "f"]
+    with open(tmp_path / "input.txt", "w") as f:
+        for _ in range(60):
+            f.write(" ".join(random.choice(words)
+                             for _ in range(10)) + ". ")
+    Engine.reset()
+    main(["-f", str(tmp_path), "-b", "8", "-e", "1", "--seqLength", "16",
+          "--dModel", "32", "--numHeads", "8", "--numLayers", "1",
+          "--sequenceParallel", "ring"])
+    Engine.reset()
