@@ -1,0 +1,43 @@
+"""Transformer LM evaluation main (the rnn Test.scala counterpart):
+loads a snapshot, evaluates per-token loss / perplexity on a text file.
+
+Run: ``python -m bigdl_tpu.models.transformer.test -f <dir> --model <snap>``.
+"""
+from __future__ import annotations
+
+import math
+
+from bigdl_tpu.models.utils.cli import (base_test_parser, init_engine,
+                                        setup_logging)
+
+
+def main(argv=None):
+    setup_logging()
+    parser = base_test_parser("Evaluate a Transformer LM")
+    parser.add_argument("--vocabSize", type=int, default=4000)
+    parser.add_argument("--seqLength", type=int, default=128)
+    args = parser.parse_args(argv)
+    mesh = init_engine(getattr(args, "chips", None))
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models.utils.text_lm import build_text_lm_datasets
+    from bigdl_tpu.optim import Loss
+    from bigdl_tpu.optim.validator import LocalValidator
+    from bigdl_tpu.utils import file as bfile
+
+    _, val_set, _, _ = build_text_lm_datasets(
+        args.folder, args.vocabSize, args.seqLength, args.batchSize,
+        one_hot=False)
+    model = bfile.load_module(args.model)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    validator = LocalValidator(model, val_set)
+    results = validator.test([Loss(criterion)])
+    for result, method in results:
+        print(f"{type(method).__name__} is {result}")
+        mean_loss = result.result()[0]
+        print(f"perplexity is {math.exp(min(mean_loss, 20.0)):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,8 +5,8 @@ blocks that can shard the sequence over the mesh ``seq`` axis.
 
 Run: ``python -m bigdl_tpu.models.transformer.train -f <dir_with_input.txt>
 [--seqLength 128] [--sequenceParallel ring|ulysses]``. With
-``--sequenceParallel`` the mesh is built as {data: 1, seq: n_chips} and
-``seqLength`` must divide the chip count.
+``--sequenceParallel`` the mesh is built as {data: 1, seq: n_chips}; the
+chip count must divide ``seqLength`` (and, for ulysses, ``numHeads``).
 """
 from __future__ import annotations
 
@@ -27,16 +27,12 @@ def main(argv=None):
                         choices=[None, "ring", "ulysses"])
     args = parser.parse_args(argv)
 
-    if args.sequenceParallel:
-        # ring/ulysses attention shards dim 1 over a 'seq' mesh axis —
-        # the default data-only mesh cannot carry it
-        import jax
-
-        from bigdl_tpu.parallel.engine import Engine
-        n = args.chips or jax.device_count()
-        mesh = Engine.init(axes={"data": 1, "seq": n})
-    else:
-        mesh = init_engine(args.chips)
+    # ring/ulysses attention shards dim 1 over a 'seq' mesh axis — the
+    # default data-only mesh cannot carry it
+    mesh = init_engine(
+        args.chips,
+        axes=(lambda n: {"data": 1, "seq": n})
+        if args.sequenceParallel else None)
 
     from bigdl_tpu import nn
     from bigdl_tpu.models import TransformerLM

@@ -50,8 +50,13 @@ def base_test_parser(description: str) -> argparse.ArgumentParser:
     return p
 
 
-def init_engine(chips: int | None = None):
-    """Build the device mesh (reference Engine.init, SURVEY §2.4)."""
+def init_engine(chips: int | None = None, axes=None):
+    """Build the device mesh (reference Engine.init, SURVEY §2.4).
+
+    ``axes``: callable n_chips -> axes dict for non-default topologies
+    (e.g. ``lambda n: {"data": 1, "seq": n}`` for sequence parallelism);
+    default is pure data parallelism.
+    """
     import jax
 
     from bigdl_tpu.parallel.engine import Engine
@@ -59,4 +64,5 @@ def init_engine(chips: int | None = None):
     devs = jax.devices()
     n = chips or len(devs)
     Engine.reset()
-    return Engine.init(axes={"data": n}, devices=devs[:n])
+    axes_dict = axes(n) if axes is not None else {"data": n}
+    return Engine.init(axes=axes_dict, devices=devs[:n])

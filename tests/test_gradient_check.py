@@ -28,7 +28,7 @@ def _fd_grad(f, x, eps=1e-3):
     return out
 
 
-def _check(module, shape, seed=0, tol=2e-2):
+def _check(module, shape, seed=0, tol=2e-2, training=False):
     module.materialize(jax.random.PRNGKey(seed))
     module.training()
     rng = np.random.default_rng(seed)
@@ -37,19 +37,19 @@ def _check(module, shape, seed=0, tol=2e-2):
     # far below the finite-difference signal (sum-of-squares made the
     # scalar ~100x larger and FD noise comparable to real gradients)
     y0, _ = module.apply(module.params, module.state, jnp.asarray(x),
-                         training=False)
+                         training=training)
     w = jnp.asarray((rng.standard_normal(y0.shape)
                      / np.sqrt(y0.size)).astype(np.float32))
 
     def scalar(v):
         y, _ = module.apply(module.params, module.state,
                             jnp.asarray(np.asarray(v, np.float32)),
-                            training=False)
+                            training=training)
         return float(jnp.sum(y.astype(jnp.float32) * w))
 
     g = jax.grad(lambda v: jnp.sum(
         module.apply(module.params, module.state, v,
-                     training=False)[0].astype(jnp.float32) * w))(
+                     training=training)[0].astype(jnp.float32) * w))(
         jnp.asarray(x))
     g = np.asarray(g).reshape(-1)
     fd = _fd_grad(scalar, x)
@@ -68,8 +68,11 @@ class TestGradientCheck:
     def test_maxpool_select_scatter(self):
         _check(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(), (2, 4, 6, 6))
 
-    def test_batchnorm(self):
-        _check(nn.SpatialBatchNormalization(4), (4, 4, 5, 5))
+    def test_batchnorm_training_stats_backward(self):
+        # training=True: the gradient flows through the batch mean/var
+        # reduction, not just the running-stats affine
+        _check(nn.SpatialBatchNormalization(4), (4, 4, 5, 5),
+               training=True)
 
     def test_whole_lenet(self):
         from bigdl_tpu.models import LeNet5
