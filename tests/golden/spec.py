@@ -20,6 +20,9 @@ MODEL_SPECS = {
              "dataset": m.DatasetType.CIFAR10}), (2, 3, 32, 32)),
     "autoencoder": (lambda m: m.Autoencoder(32), (2, 784)),
     "simplernn": (lambda m: m.SimpleRNN(100, 40, 10), (2, 8, 100)),
+    "transformer_lm": (lambda m: m.TransformerLM(
+        50, d_model=32, num_heads=4, num_layers=2, max_len=16),
+        (2, 16)),
 }
 
 
@@ -36,7 +39,11 @@ def build(name):
     model = ctor(models)
     model.materialize(jax.random.PRNGKey(0))
     model.evaluate()
-    x = np.random.default_rng(42).standard_normal(shape).astype(np.float32)
+    rng = np.random.default_rng(42)
+    if name == "transformer_lm":   # token ids, 1-based
+        x = rng.integers(1, 51, size=shape)
+    else:
+        x = rng.standard_normal(shape).astype(np.float32)
     return model, x
 
 
