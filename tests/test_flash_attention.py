@@ -1,0 +1,115 @@
+"""Pallas flash-attention kernel vs the XLA attention path.
+
+Runs the kernel in interpret mode on CPU (same convention as the LRN
+kernel tests in test_perf_paths.py). Tolerances are ~1e-3 because BOTH
+paths round matmul operands to bf16 under JAX's default matmul precision
+— measured: a 128-deep f32 dot differs from f64 by ~6e-3 at default
+precision and ~3e-7 at "highest" — so the comparison pins algorithmic
+equivalence, not operand precision (inputs are scaled to keep the
+softmax temperate, as peaked softmaxes amplify logit rounding).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.ops.pallas.flash_attention import flash_attention
+from bigdl_tpu.parallel.sequence import dot_product_attention
+
+INTERP = jax.default_backend() != "tpu"
+
+
+def _qkv(rng, b, s, h, d, skv=None):
+    skv = s if skv is None else skv
+    q = jnp.asarray(0.2 * rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(0.2 * rng.standard_normal((b, skv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, skv, h, d)), jnp.float32)
+    return q, k, v
+
+
+def _naive(q, k, v, causal):
+    return dot_product_attention(q, k, v, causal=causal, flash=False)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla_path(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, 2, 256, 2, 128)
+    o_fl = flash_attention(q, k, v, causal=causal, interpret=INTERP)
+    o_nv = _naive(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o_fl), np.asarray(o_nv),
+                               rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_xla_path(causal):
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, 2, 256, 2, 128)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    def loss_fl(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal=causal,
+                                        interpret=INTERP), ct)
+
+    def loss_nv(q, k, v):
+        return jnp.vdot(_naive(q, k, v, causal), ct)
+
+    g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    g_nv = jax.grad(loss_nv, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fl, g_nv):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_cross_attention_shapes():
+    """S_q != S_kv (cross attention) with uneven block pick (384 = 3*128)."""
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, 1, 128, 2, 128, skv=384)
+    o_fl = flash_attention(q, k, v, interpret=INTERP)
+    o_nv = _naive(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(o_fl), np.asarray(o_nv),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_causal_first_row_attends_only_itself():
+    """Row 0 under causal masking = v[0] exactly (softmax over one key)."""
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, 1, 128, 1, 128)
+    o = flash_attention(q, k, v, causal=True, interpret=INTERP)
+    np.testing.assert_allclose(np.asarray(o[0, 0, 0]),
+                               np.asarray(v[0, 0, 0]), rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_io_f32_internals():
+    rng = np.random.default_rng(4)
+    q, k, v = _qkv(rng, 1, 256, 2, 128)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    o = flash_attention(qb, kb, vb, causal=True, interpret=INTERP)
+    assert o.dtype == jnp.bfloat16
+    o_nv = _naive(qb, kb, vb, True)
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(o_nv, np.float32),
+                               rtol=5e-2, atol=2e-2)
+
+
+def test_auto_dispatch_falls_back_off_tpu_or_bad_shapes():
+    """dot_product_attention(flash="auto") must not require the kernel:
+    odd shapes (here head_dim 32) always take the XLA path."""
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, 2, 96, 2, 32)
+    o = dot_product_attention(q, k, v, causal=True)  # flash="auto"
+    o_ref = _naive(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref))
+
+
+def test_flash_inside_multihead_attention_module():
+    """MultiHeadAttention's local core goes through dot_product_attention
+    — auto dispatch must keep module semantics identical."""
+    from bigdl_tpu import nn
+    m = nn.MultiHeadAttention(256, 2, causal=True)
+    m.materialize(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(6).standard_normal(
+        (2, 128, 256)).astype(np.float32))
+    y, _ = m.apply(m.params, {}, x)
+    assert y.shape == (2, 128, 256)
+    assert np.isfinite(np.asarray(y, np.float32)).all()
