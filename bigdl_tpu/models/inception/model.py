@@ -18,7 +18,7 @@ reference's hand-threaded Concat copies (nn/Concat.scala:42-80) away.
 """
 from __future__ import annotations
 
-from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU,
+from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Remat,
                           Sequential, SpatialAveragePooling,
                           SpatialBatchNormalization, SpatialConvolution,
                           SpatialCrossMapLRN, SpatialMaxPooling, View)
@@ -93,29 +93,37 @@ def _v1_stem():
             .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
 
 
-def Inception_v1_NoAuxClassifier(class_num: int) -> Sequential:
-    """(reference Inception_v1.scala:60-94)"""
+def Inception_v1_NoAuxClassifier(class_num: int,
+                                 remat: bool = False) -> Sequential:
+    """(reference Inception_v1.scala:60-94)
+
+    ``remat=True`` wraps each inception block in ``nn.Remat`` —
+    pytree-transparent, so imports/fixtures are unaffected; backward
+    recomputes block interiors instead of loading saved activations
+    (measured on v5e: see docs/PERF.md remat section).
+    """
+    wrap = Remat if remat else (lambda m: m)
     model = _v1_stem()
-    model.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
-                                 "inception_3a/"))
-    model.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
-                                 "inception_3b/"))
+    model.add(wrap(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
+                                 "inception_3a/")))
+    model.add(wrap(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
+                                 "inception_3b/")))
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
-    model.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
-                                 "inception_4a/"))
-    model.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
-                                 "inception_4b/"))
-    model.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
-                                 "inception_4c/"))
-    model.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
-                                 "inception_4d/"))
-    model.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
-                                 "inception_4e/"))
+    model.add(wrap(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
+                                 "inception_4a/")))
+    model.add(wrap(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
+                                 "inception_4b/")))
+    model.add(wrap(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
+                                 "inception_4c/")))
+    model.add(wrap(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
+                                 "inception_4d/")))
+    model.add(wrap(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
+                                 "inception_4e/")))
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
-    model.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
-                                 "inception_5a/"))
-    model.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
-                                 "inception_5b/"))
+    model.add(wrap(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
+                                 "inception_5a/")))
+    model.add(wrap(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
+                                 "inception_5b/")))
     model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
     model.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
     model.add(View(1024))

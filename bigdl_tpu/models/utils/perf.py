@@ -24,17 +24,70 @@ MODELS = {
 }
 
 
+def _attention_perf(args):
+    """Long-context attention: fused Pallas kernel vs the XLA path,
+    fwd+bwd per sequence (the long-context hot loop, docs/PERF.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.parallel.sequence import dot_product_attention
+
+    b, s, h, d = args.batchSize, args.seqLen, args.heads, args.headDim
+    dtype = jnp.bfloat16 if args.dataType == "bf16" else jnp.float32
+    host = np.random.default_rng(0)
+    q, k, v, ct = (jnp.asarray(0.3 * host.standard_normal(
+        (b, s, h, d)).astype(np.float32), dtype) for _ in range(4))
+
+    def bench(flash):
+        fn = jax.jit(jax.grad(lambda q, k, v: jnp.vdot(
+            dot_product_attention(q, k, v, causal=True,
+                                  flash=flash).astype(jnp.float32),
+            ct.astype(jnp.float32)), argnums=(0, 1, 2)))
+        try:
+            g = fn(q, k, v)
+        except Exception as e:  # XLA path OOMs at long S — report it
+            return None, type(e).__name__
+        for _ in range(args.warmUp - 1):
+            g = fn(q, k, v)
+        jax.tree.map(lambda a: float(jnp.sum(a.astype(jnp.float32))), g)
+        t0 = time.perf_counter()
+        for _ in range(args.iteration):
+            g = fn(q, k, v)
+        jax.tree.map(lambda a: float(jnp.sum(a.astype(jnp.float32))), g)
+        return (time.perf_counter() - t0) / args.iteration * 1e3, None
+
+    for name, flash in (("flash", "auto"), ("xla", False)):
+        ms, err = bench(flash)
+        if ms is None:
+            print(f"attention[{name}] B{b} S{s} H{h} D{d}: FAILED ({err})")
+        else:
+            print(f"attention[{name}] B{b} S{s} H{h} D{d}: {ms:.2f} "
+                  f"ms/iteration fwd+bwd ({b * s / ms:.0f} tokens/ms)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description="training perf harness")
     parser.add_argument("-m", "--module", default="inception_v1",
-                        choices=sorted(MODELS))
-    parser.add_argument("-b", "--batchSize", type=int, default=128)
+                        choices=sorted(MODELS) + ["attention"])
+    parser.add_argument("-b", "--batchSize", type=int, default=None,
+                        help="default: 128 (conv models), 4 (attention)")
     parser.add_argument("-i", "--iteration", type=int, default=30)
     parser.add_argument("--warmUp", type=int, default=5)
     parser.add_argument("--classNum", type=int, default=1000)
     parser.add_argument("--dataType", default="bf16",
                         choices=["f32", "bf16"])
+    parser.add_argument("--seqLen", type=int, default=4096,
+                        help="attention mode: sequence length")
+    parser.add_argument("--heads", type=int, default=8,
+                        help="attention mode: heads")
+    parser.add_argument("--headDim", type=int, default=128,
+                        help="attention mode: head dim")
     args = parser.parse_args(argv)
+
+    if args.batchSize is None:
+        args.batchSize = 4 if args.module == "attention" else 128
+    if args.module == "attention":
+        return _attention_perf(args)
 
     import jax
     import jax.numpy as jnp

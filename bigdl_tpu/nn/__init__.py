@@ -2,7 +2,7 @@
 
 from bigdl_tpu.nn.module import Module, Container, Criterion, Identity, Echo
 from bigdl_tpu.nn.containers import (Sequential, Concat, ConcatTable,
-                                     ParallelTable, MapTable, Bottle)
+                                     ParallelTable, MapTable, Bottle, Remat)
 from bigdl_tpu.nn.linear import (Linear, Bilinear, LookupTable, Cosine,
                                  Euclidean, Add, CAdd, CMul, Mul, MM, MV)
 from bigdl_tpu.nn.activations import (

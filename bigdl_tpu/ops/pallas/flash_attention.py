@@ -43,8 +43,13 @@ _K_BLOCKS = (1024, 512, 256, 128)
 
 
 def _pick_blocks(sq: int, skv: int) -> tuple[int, int]:
-    bq = next(b for b in _Q_BLOCKS if sq % b == 0)
-    bk = next(b for b in _K_BLOCKS if skv % b == 0)
+    bq = next((b for b in _Q_BLOCKS if sq % b == 0), None)
+    bk = next((b for b in _K_BLOCKS if skv % b == 0), None)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention needs sequence lengths divisible by "
+            f"{_Q_BLOCKS[-1]}; got q_seq={sq}, kv_seq={skv} "
+            f"(use dot_product_attention's XLA path for ragged shapes)")
     return bq, bk
 
 _NEG = -1e9  # finite mask value, matches parallel/sequence.py

@@ -64,15 +64,18 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     path); both share bf16-operand matmul rounding, so they agree to
     ~1e-3 under a temperate softmax.
     """
-    if flash and (not causal or (q_offset == 0 and kv_offset == 0)):
+    if flash:
         from bigdl_tpu.ops.pallas.flash_attention import (flash_attention,
                                                           flash_supported)
-        supported = flash_supported(q, k)
+        offsets_ok = not causal or (q_offset == 0 and kv_offset == 0)
+        supported = offsets_ok and flash_supported(q, k)
         if flash is True and not supported:
             raise ValueError(
                 f"flash=True but the kernel does not support this call: "
-                f"backend={jax.default_backend()}, q{q.shape} k{k.shape} "
-                f"(need TPU, seq % 128 == 0, head_dim % 128 == 0)")
+                f"backend={jax.default_backend()}, q{q.shape} k{k.shape}, "
+                f"q_offset={q_offset} kv_offset={kv_offset} (need TPU, "
+                f"seq % 128 == 0, head_dim % 128 == 0, zero offsets when "
+                f"causal)")
         if supported:
             return flash_attention(q, k, v, causal=causal, scale=scale)
     f32 = jnp.float32
