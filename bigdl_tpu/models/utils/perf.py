@@ -56,7 +56,9 @@ def _attention_perf(args):
         jax.tree.map(lambda a: float(jnp.sum(a.astype(jnp.float32))), g)
         return (time.perf_counter() - t0) / args.iteration * 1e3, None
 
-    for name, flash in (("flash", "auto"), ("xla", False)):
+    # flash=True (not "auto") so an unsupported config prints FAILED
+    # instead of silently benchmarking the XLA path under the flash label
+    for name, flash in (("flash", True), ("xla", False)):
         ms, err = bench(flash)
         if ms is None:
             print(f"attention[{name}] B{b} S{s} H{h} D{d}: FAILED ({err})")

@@ -230,13 +230,16 @@ class SpatialSubtractiveNormalization(Module):
             padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         # divide by local window mass (border correction, as Torch does via
-        # convolving a ones image)
+        # convolving a ones image; the kernel is already normalized by
+        # ksum * n_input_plane, so interior coef == 1 — dividing by
+        # coef * n again would shrink the mean n-fold, caught by
+        # test_subtractive_normalization_zeroes_constant_input)
         ones = jnp.ones((1, self.n_input_plane) + x.shape[2:], x.dtype)
         coef = jax.lax.conv_general_dilated(
             ones, w.astype(x.dtype), (1, 1),
             padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return mean / (coef * self.n_input_plane)
+        return mean / coef
 
     def apply(self, params, state, x, *, training=False, rng=None):
         squeeze = x.ndim == 3
