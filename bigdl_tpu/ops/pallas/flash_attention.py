@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_supported"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "flash_supported"]
 
 # block-size menu: largest tile dividing the sequence wins — bigger tiles
 # amortize grid overhead and keep the MXU busy (512x1024 measured 2.7x the
@@ -227,14 +228,23 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, interpret, res, g):
+    """VJP for (o, lse) outputs.
+
+    The lse cotangent folds into the existing kernels: with lse an
+    output, ds_ij gains + g_lse_i * p_ij (d lse_i / d s_ij = p_ij), so
+    ds = p * (dp - (delta - g_lse)) — pass delta' = delta - g_lse and
+    the dq/dkdv kernels are unchanged. dv has no direct lse term.
+    """
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, o, lse = res
+    g, g_lse = g
     bh, sq, d = q.shape
     skv = k.shape[1]
     bq, bk = _pick_blocks(sq, skv)
     nq, nk = sq // bq, skv // bk
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    delta = delta - g_lse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     kv_spec_q = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
@@ -278,13 +288,12 @@ def _bwd(scale, causal, interpret, res, g):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_bhsd(q, k, v, scale, causal, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, interpret)
-    return o
+    return _fwd(q, k, v, scale, causal, interpret)
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret):
     o, lse = _fwd(q, k, v, scale, causal, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd, _bwd)
@@ -299,11 +308,27 @@ def flash_attention(q, k, v, *, causal: bool = False,
     and head_dim a multiple of 128 lanes (``flash_supported``); tile
     sizes then scale up with S (``_pick_blocks``).
     """
+    o, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    interpret=interpret)
+    return o
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: float | None = None,
+                             interpret: bool = False):
+    """``flash_attention`` that also returns the per-row logsumexp
+    (B, S, H) of the scaled scores — the statistic blockwise/ring
+    attention needs to merge partial results across sequence shards
+    (parallel/sequence.py). Both outputs are differentiable.
+    """
     b, sq, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
 
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    o = _flash_bhsd(fold(q), fold(k), fold(v), scale, causal, interpret)
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    o, lse = _flash_bhsd(fold(q), fold(k), fold(v), scale, causal,
+                         interpret)
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return o, lse

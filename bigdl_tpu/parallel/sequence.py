@@ -89,6 +89,67 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(f32)).astype(q.dtype)
 
 
+def _merge_blocks(o, lse, o_t, lse_t):
+    """Fold a block's (o_t, lse_t) into the running (o, lse) — the
+    standard blockwise-softmax merge (numerically safe when either side
+    is -inf, i.e. empty)."""
+    m = jnp.maximum(lse, lse_t)
+    # guard fully-empty rows (both -inf): keep weights at 0
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w, w_t = jnp.exp(lse - m_safe), jnp.exp(lse_t - m_safe)
+    denom = w + w_t
+    d_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o_new = (o * w[..., None] + o_t * w_t[..., None]) / d_safe[..., None]
+    return o_new, m_safe + jnp.log(d_safe)
+
+
+def _ring_body_flash(q, k, v, *, axis, n, causal, scale, interpret):
+    """Ring attention whose per-step local attention is the fused Pallas
+    flash kernel: each rotating K/V block contributes (o_t, lse_t) and the
+    shards merge by logsumexp. Per-chip live memory is O(S_local * D) —
+    the (S_local, S_local) score tile never exists outside VMEM.
+
+    Causal masking needs no traced offsets inside the kernel: a block is
+    fully-visible (source shard before mine), diagonal (same shard —
+    plain local causal mask), or fully-masked (skipped via lax.switch).
+    """
+    from bigdl_tpu.ops.pallas.flash_attention import flash_attention_with_lse
+    f32 = jnp.float32
+    b, sq, h, d = q.shape
+    idx = jax.lax.axis_index(axis)
+    o = jnp.zeros((b, sq, h, d), f32)
+    lse = jnp.full((b, sq, h), -jnp.inf, f32)
+    perm = [(j, (j - 1) % n) for j in range(n)]  # receive from the right
+
+    def full_fn(q, k, v):
+        o_t, l_t = flash_attention_with_lse(q, k, v, causal=False,
+                                            scale=scale, interpret=interpret)
+        return o_t.astype(f32), l_t
+
+    def diag_fn(q, k, v):
+        o_t, l_t = flash_attention_with_lse(q, k, v, causal=True,
+                                            scale=scale, interpret=interpret)
+        return o_t.astype(f32), l_t
+
+    def skip_fn(q, k, v):
+        return jnp.zeros((b, sq, h, d), f32), jnp.full((b, sq, h), -jnp.inf,
+                                                       f32)
+
+    for t in range(n):
+        src = (idx + t) % n                      # global block id of k/v
+        if causal:
+            case = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+            o_t, lse_t = jax.lax.switch(case, (full_fn, diag_fn, skip_fn),
+                                        q, k, v)
+        else:
+            o_t, lse_t = full_fn(q, k, v)
+        o, lse = _merge_blocks(o, lse, o_t, lse_t)
+        if t != n - 1:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+    return o.astype(q.dtype)
+
+
 def _ring_body(q, k, v, *, axis, n, causal, scale):
     """Per-shard ring attention: local q block, rotating k/v blocks."""
     f32 = jnp.float32
@@ -125,13 +186,51 @@ def _ring_body(q, k, v, *, axis, n, causal, scale):
     return out.astype(q.dtype)
 
 
+def _flash_ring_ok(q, k, q_local, kv_local, causal, flash):
+    """Whether the per-shard flash path applies (mirrors flash_supported,
+    but on the LOCAL shard lengths). ``flash=True`` raises when the
+    kernel cannot serve the call — same contract as
+    ``dot_product_attention``; "auto" quietly falls back.
+
+    Causal additionally requires equal q/kv shard lengths: the ring
+    block classification (src < idx fully visible, src == idx local
+    causal) only matches global-position masking when the shards are the
+    same length (_ring_body masks on idx*sq vs src*skv and stays correct
+    for cross-length causal calls).
+    """
+    if flash is False:
+        return False
+    from bigdl_tpu.ops.pallas.flash_attention import _Q_BLOCKS
+    shapes_ok = (q_local % _Q_BLOCKS[-1] == 0
+                 and kv_local % _Q_BLOCKS[-1] == 0
+                 and k.shape[-1] % 128 == 0
+                 and not (causal and q_local != kv_local))
+    if flash is True and not shapes_ok:
+        raise ValueError(
+            f"flash=True but the ring flash path does not support this "
+            f"call: local shards q={q_local} kv={kv_local}, "
+            f"head_dim={k.shape[-1]}, causal={causal} (need shard "
+            f"lengths % 128 == 0, head_dim % 128 == 0, and equal q/kv "
+            f"shard lengths when causal)")
+    if flash == "auto":
+        return shapes_ok and jax.default_backend() == "tpu"
+    return shapes_ok
+
+
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: float | None = None, axis: str = "seq",
-                   mesh: Mesh | None = None, batch_axis="auto"):
+                   mesh: Mesh | None = None, batch_axis="auto",
+                   flash: str | bool = "auto", interpret: bool = False):
     """Sequence-parallel attention; q/k/v sharded on dim 1 over ``axis``.
 
     Call eagerly with global arrays (this wrapper shards them) or use
     ``ring_attention_sharded`` inside an existing shard_map/pjit region.
+
+    ``flash="auto"`` runs each shard's local block attention through the
+    fused Pallas kernel on TPU when the local shard length divides the
+    kernel tiles (O(S_local*D) live memory); ``flash=False`` keeps the
+    XLA online-softmax body; ``flash=True`` forces the kernel
+    (``interpret=True`` for CPU testing).
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -140,8 +239,14 @@ def ring_attention(q, k, v, *, causal: bool = False,
             f"sequence length {q.shape[1]}/{k.shape[1]} not divisible by "
             f"mesh axis '{axis}' size {n}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    use_flash = _flash_ring_ok(q, k, q.shape[1] // n, k.shape[1] // n,
+                               causal, flash)
 
     def body(qb, kb, vb):
+        if use_flash:
+            return _ring_body_flash(qb, kb, vb, axis=axis, n=n,
+                                    causal=causal, scale=scale,
+                                    interpret=interpret)
         return _ring_body(qb, kb, vb, axis=axis, n=n, causal=causal,
                           scale=scale)
 
@@ -152,11 +257,16 @@ def ring_attention(q, k, v, *, causal: bool = False,
 
 def ring_attention_sharded(q, k, v, *, causal: bool = False,
                            scale: float | None = None, axis: str = "seq",
-                           axis_size: int | None = None):
+                           axis_size: int | None = None,
+                           flash: str | bool = "auto",
+                           interpret: bool = False):
     """The per-shard ring computation, for use INSIDE shard_map/pjit where
     ``q``/``k``/``v`` are already the local sequence blocks."""
     n = axis_size if axis_size is not None else jax.lax.axis_size(axis)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if _flash_ring_ok(q, k, q.shape[1], k.shape[1], causal, flash):
+        return _ring_body_flash(q, k, v, axis=axis, n=n, causal=causal,
+                                scale=scale, interpret=interpret)
     return _ring_body(q, k, v, axis=axis, n=n, causal=causal, scale=scale)
 
 

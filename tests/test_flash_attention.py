@@ -113,3 +113,99 @@ def test_flash_inside_multihead_attention_module():
     y, _ = m.apply(m.params, {}, x)
     assert y.shape == (2, 128, 256)
     assert np.isfinite(np.asarray(y, np.float32)).all()
+
+
+def test_with_lse_cotangent_math():
+    """(o, lse) are both differentiable: d/dq of sum(lse) must match the
+    XLA logsumexp path (the lse cotangent folds into delta' = delta -
+    g_lse in the backward kernels)."""
+    from bigdl_tpu.ops.pallas.flash_attention import flash_attention_with_lse
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, 1, 256, 2, 128)
+
+    def lse_flash(q, k, v):
+        _, lse = flash_attention_with_lse(q, k, v, interpret=INTERP)
+        return jnp.sum(lse)
+
+    def lse_xla(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (128 ** -0.5)
+        return jnp.sum(jax.nn.logsumexp(s, axis=-1))
+
+    np.testing.assert_allclose(float(lse_flash(q, k, v)),
+                               float(lse_xla(q, k, v)), rtol=1e-4)
+    g_fl = jax.grad(lse_flash, argnums=(0, 1))(q, k, v)
+    g_nv = jax.grad(lse_xla, argnums=(0, 1))(q, k, v)
+    for a, b in zip(g_fl, g_nv):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_body_matches_full(causal):
+    """Ring attention with the per-step flash kernel (interpret mode on a
+    4-way seq mesh) == unsharded full attention, values and grads."""
+    import jax as _jax
+    from bigdl_tpu.parallel.engine import Engine
+    from bigdl_tpu.parallel.sequence import ring_attention
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, 2, 512, 2, 128)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    Engine.reset()
+    mesh = Engine.init(axes={"seq": 4}, devices=_jax.devices()[:4])
+    try:
+        with mesh:
+            o = ring_attention(q, k, v, causal=causal, flash=True,
+                               interpret=True)
+            o_ref = _naive(q, k, v, causal)
+            np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                       rtol=2e-3, atol=2e-4)
+            g = _jax.grad(lambda q, k, v: jnp.vdot(
+                ring_attention(q, k, v, causal=causal, flash=True,
+                               interpret=True), ct),
+                argnums=(0, 1, 2))(q, k, v)
+            g_ref = _jax.grad(lambda q, k, v: jnp.vdot(
+                _naive(q, k, v, causal), ct), argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(g, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-3, atol=2e-4)
+    finally:
+        Engine.reset()
+
+
+def test_ring_flash_guards():
+    """Review r2: causal cross-length and undersized K/V shards must not
+    take the flash ring body; flash=True raises, auto falls back."""
+    import jax as _jax
+    from bigdl_tpu.parallel.engine import Engine
+    from bigdl_tpu.parallel.sequence import ring_attention
+    rng = np.random.default_rng(9)
+    q, _, _ = _qkv(rng, 1, 1024, 2, 128)
+    _, k, v = _qkv(rng, 1, 512, 2, 128)
+    ct_q = q
+    Engine.reset()
+    mesh = Engine.init(axes={"seq": 4}, devices=_jax.devices()[:4])
+    try:
+        with mesh:
+            # causal cross-length: forced flash raises...
+            with pytest.raises(ValueError, match="equal q/kv"):
+                ring_attention(q, k, v, causal=True, flash=True,
+                               interpret=True)
+            # ...auto falls back to the XLA body and matches the oracle
+            o = ring_attention(q, k, v, causal=True)   # flash="auto"
+            o_ref = _naive(q, k, v, True)
+            np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                       rtol=2e-3, atol=2e-4)
+            # kv shard 64 (< min tile): forced flash raises instead of
+            # crashing inside _pick_blocks
+            _, k2, v2 = _qkv(rng, 1, 256, 2, 128)
+            with pytest.raises(ValueError, match="kv=64"):
+                ring_attention(q[:, :512], k2, v2, causal=False,
+                               flash=True, interpret=True)
+            # non-causal cross-length IS flash-eligible and correct
+            o2 = ring_attention(q, k, v, causal=False, flash=True,
+                                interpret=True)
+            o2_ref = _naive(q, k, v, False)
+            np.testing.assert_allclose(np.asarray(o2), np.asarray(o2_ref),
+                                       rtol=2e-3, atol=2e-4)
+    finally:
+        Engine.reset()
