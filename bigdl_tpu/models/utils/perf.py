@@ -67,19 +67,84 @@ def _attention_perf(args):
                   f"ms/iteration fwd+bwd ({b * s / ms:.0f} tokens/ms)")
 
 
+def _transformer_perf(args):
+    """LM train-step throughput (tokens/s) — the docs/PERF.md flagship
+    config: d_model 512, 6 layers, 4x128 heads, vmapped
+    TimeDistributedCriterion, flash attention via auto dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.tensor import DTypePolicy, set_policy
+
+    if args.dataType == "bf16":
+        set_policy(DTypePolicy(param_dtype=jnp.float32,
+                               compute_dtype=jnp.bfloat16,
+                               activation_dtype=jnp.bfloat16))
+    vocab, s, b = args.classNum, args.seqLen, args.batchSize
+    model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
+                          max_len=s)
+    model.materialize(jax.random.PRNGKey(0))
+    model.training()
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    optim = SGD(learning_rate=0.1)
+    params, mstate = model.params, model.state
+    opt_state = optim.init_state(params)
+
+    def step(params, mstate, opt_state, data, labels):
+        def loss_fn(p):
+            y, st = model.apply(p, mstate, data, training=True)
+            return crit.apply(y, labels), st
+        (loss, s2), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        p2, o2 = optim.update(g, params, opt_state)
+        return p2, s2, o2, loss
+
+    host = np.random.default_rng(0)
+    data = jnp.asarray(host.integers(1, vocab + 1, size=(b, s)))
+    labels = jnp.asarray(host.integers(1, vocab + 1, size=(b, s)))
+    c = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+        params, mstate, opt_state, data, labels).compile()
+    for _ in range(max(args.warmUp, 1)):   # >=1: bind loss for the sync
+        params, mstate, opt_state, loss = c(params, mstate, opt_state,
+                                            data, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.iteration):
+        params, mstate, opt_state, loss = c(params, mstate, opt_state,
+                                            data, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    cost = c.cost_analysis()
+    line = (f"transformer: {b * s * args.iteration / dt:,.0f} tokens/s "
+            f"({dt / args.iteration * 1000:.1f} ms/step, B{b} S{s} "
+            f"vocab {vocab})")
+    if cost and cost.get("flops"):
+        line += (f" [{cost['flops'] * args.iteration / dt / 1e12:.1f} "
+                 f"TFLOP/s achieved]")
+    print(line)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description="training perf harness")
     parser.add_argument("-m", "--module", default="inception_v1",
-                        choices=sorted(MODELS) + ["attention"])
+                        choices=sorted(MODELS) + ["attention",
+                                                  "transformer"])
     parser.add_argument("-b", "--batchSize", type=int, default=None,
-                        help="default: 128 (conv models), 4 (attention)")
+                        help="default: 128 (conv models), 4 (attention), "
+                             "8 (transformer)")
     parser.add_argument("-i", "--iteration", type=int, default=30)
     parser.add_argument("--warmUp", type=int, default=5)
-    parser.add_argument("--classNum", type=int, default=1000)
+    parser.add_argument("--classNum", type=int, default=None,
+                        help="default: 1000 (conv models), vocab 8192 "
+                             "(transformer)")
     parser.add_argument("--dataType", default="bf16",
                         choices=["f32", "bf16"])
-    parser.add_argument("--seqLen", type=int, default=4096,
-                        help="attention mode: sequence length")
+    parser.add_argument("--seqLen", type=int, default=None,
+                        help="sequence length; default 4096 (attention), "
+                             "2048 (transformer, the docs/PERF.md "
+                             "flagship config)")
     parser.add_argument("--heads", type=int, default=8,
                         help="attention mode: heads")
     parser.add_argument("--headDim", type=int, default=128,
@@ -87,9 +152,16 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     if args.batchSize is None:
-        args.batchSize = 4 if args.module == "attention" else 128
+        args.batchSize = {"attention": 4, "transformer": 8}.get(
+            args.module, 128)
+    if args.seqLen is None:
+        args.seqLen = 2048 if args.module == "transformer" else 4096
+    if args.classNum is None:
+        args.classNum = 8192 if args.module == "transformer" else 1000
     if args.module == "attention":
         return _attention_perf(args)
+    if args.module == "transformer":
+        return _transformer_perf(args)
 
     import jax
     import jax.numpy as jnp

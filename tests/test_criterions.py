@@ -216,3 +216,31 @@ class TestComposite:
         m = nn.L1Penalty(0.1)
         g = m.backward(jnp.asarray(x), jnp.ones((3, 3)))
         assert_close(g, 1.0 + 0.1 * np.sign(x))
+
+
+def test_time_distributed_vmap_matches_explicit_loop():
+    """The vmapped TimeDistributedCriterion (docs/PERF.md 10.4x fix) must
+    equal the reference's explicit per-timestep sum for inner criteria
+    with and without size averaging."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((4, 6, 10)).astype(np.float32))
+    logp = jax.nn.log_softmax(x, axis=-1)
+    t = jnp.asarray(rng.integers(1, 11, size=(4, 6)))
+    for inner in (nn.ClassNLLCriterion(),
+                  nn.ClassNLLCriterion(size_average=False)):
+        for size_average in (False, True):
+            c = nn.TimeDistributedCriterion(inner, size_average)
+            got = float(c.apply(logp, t))
+            want = sum(float(inner.apply(logp[:, i], t[:, i]))
+                       for i in range(6))
+            if size_average:
+                want /= 6
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+    # MSE inner over (N, T, D) regression targets
+    y = jnp.asarray(rng.standard_normal((4, 6, 3)).astype(np.float32))
+    p = jnp.asarray(rng.standard_normal((4, 6, 3)).astype(np.float32))
+    c = nn.TimeDistributedCriterion(nn.MSECriterion())
+    want = sum(float(nn.MSECriterion().apply(p[:, i], y[:, i]))
+               for i in range(6))
+    np.testing.assert_allclose(float(c.apply(p, y)), want, rtol=1e-5)
