@@ -1,4 +1,7 @@
+from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                    generate)
 from bigdl_tpu.models.transformer.model import (TransformerBlock,
                                                 TransformerLM)
 
-__all__ = ["TransformerLM", "TransformerBlock"]
+__all__ = ["TransformerBlock", "TransformerLM", "GenerationConfig",
+           "generate"]

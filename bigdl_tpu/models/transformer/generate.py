@@ -1,0 +1,216 @@
+"""Autoregressive decoding for ``TransformerLM`` with a static KV cache.
+
+TPU-native inference loop: the cache is a pre-allocated (L, B, max_len,
+H, Dh) pair of arrays, the decode loop is a ``lax.scan`` over token
+positions (one compiled program regardless of length), and every shape
+is static — nothing retraces as the sequence grows. The reference has no
+generation story (its RNN era predates it, SURVEY §5.7); this completes
+the transformer family's API the way Test CLIs complete the conv
+families'.
+
+Implementation note: modules are pure init/apply, so the decode path
+reuses the model's *param tree* directly (embed / blocks / final norm /
+lm head, keyed by their Sequential positions) rather than threading a
+cache through module classes — the module graph stays inference-free and
+the cache layout stays an implementation detail of this file. The tree
+layout is pinned by tests/test_generate.py's greedy-parity test: any
+change to TransformerLM's structure that breaks these paths fails
+loudly there.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.tensor import activation_dtype, compute_dtype
+
+__all__ = ["generate", "GenerationConfig"]
+
+
+class GenerationConfig:
+    """Decode knobs: temperature 0 = greedy; top_k limits the softmax
+    support; max_new_tokens is a static bound (one compile per value)."""
+
+    def __init__(self, max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int | None = None):
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+
+
+def _split_heads(x, num_heads):
+    b, s, e = x.shape
+    return x.reshape(b, s, num_heads, e // num_heads)
+
+
+def _ln(p, x, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["weight"] + p["bias"]).astype(x.dtype)
+
+
+def _proj(p, name, x):
+    # mirrors MultiHeadAttention._proj: compute-dtype operands/output
+    y = jnp.matmul(x.astype(compute_dtype()),
+                   p[f"{name}_weight"].astype(compute_dtype()).T)
+    if f"{name}_bias" in p:
+        y = y + p[f"{name}_bias"].astype(compute_dtype())
+    return y
+
+
+def _linear(p, x):
+    # mirrors nn.Linear.apply's dtype path
+    y = jnp.matmul(x.astype(compute_dtype()),
+                   p["weight"].astype(compute_dtype()).T)
+    y = y + p["bias"].astype(compute_dtype())
+    return y.astype(activation_dtype())
+
+
+def _ffn(p, x):
+    return _linear(p["2"], jax.nn.relu(_linear(p["0"], x)))
+
+
+def _block_step(bp, x, ck, cv, pos, num_heads, max_len):
+    """One TransformerBlock on a (B, T) slice ending at absolute position
+    ``pos`` (T==1 decode or T==P prefill with pos==P-1). Returns output
+    and the updated (ck, cv) cache for this layer.
+
+    Param paths (TransformerBlock): bp["0"] = _Residual(LN, MHA),
+    bp["1"] = _Residual(LN, FFN-Sequential).
+    """
+    mha_p = bp["0"]["1"]
+    h = _ln(bp["0"]["0"], x)
+    d = h.shape[-1]
+    scale = (d // num_heads) ** -0.5
+    q = _split_heads(_proj(mha_p, "q", h), num_heads)
+    k = _split_heads(_proj(mha_p, "k", h), num_heads)
+    v = _split_heads(_proj(mha_p, "v", h), num_heads)
+    t = x.shape[1]
+    start = pos - (t - 1)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, start, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, start, 0, 0))
+    # each query row i (absolute position start+i) sees cache <= start+i
+    upto = start + jnp.arange(t)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    kpos = jnp.arange(max_len)[None, None, None, :]
+    s = jnp.where(kpos > upto[None, None, :, None], -1e9, s)
+    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
+                   cv.astype(jnp.float32)).astype(x.dtype)
+    o = _proj(mha_p, "out",
+              o.reshape(x.shape)).astype(activation_dtype())
+    x = x + o
+    x = x + _ffn(bp["1"]["1"], _ln(bp["1"]["0"], x))
+    return x, ck, cv
+
+
+def _model_parts(params, num_layers):
+    """Sequential positions: 0 embed, 1..L blocks, L+1 final LN,
+    L+2 lm head (L+3 LogSoftMax is parameterless)."""
+    embed = params["0"]
+    blocks = [params[str(1 + i)] for i in range(num_layers)]
+    norm = params[str(num_layers + 1)]
+    head = params[str(num_layers + 2)]
+    return embed, blocks, norm, head
+
+
+def _embed(ep, tokens, start):
+    idx = tokens.astype(jnp.int32) - 1        # 1-based ids
+    vocab = ep["tok"].shape[0]
+    pos = jax.lax.dynamic_slice_in_dim(ep["pos"], start, tokens.shape[1],
+                                       axis=0)
+    return jnp.take(ep["tok"], jnp.clip(idx, 0, vocab - 1), axis=0) + pos
+
+
+def _logits(params, num_layers, x):
+    _, _, norm, head = _model_parts(params, num_layers)
+    return _linear(head, _ln(norm, x[:, -1]))
+
+
+def generate(model, prompt, config: GenerationConfig | None = None, *,
+             rng=None, params=None):
+    """Decode ``config.max_new_tokens`` tokens after ``prompt`` (B, P)
+    1-based token ids. Returns (B, max_new_tokens) generated ids.
+
+    ``model`` is a materialized ``TransformerLM`` (its ``num_layers``/
+    ``num_heads``/``max_len`` attributes come from the builder); pass
+    ``params`` to decode with externally-updated parameters.
+    """
+    config = config or GenerationConfig()
+    params = model.params if params is None else params
+    meta = getattr(model, "lm_meta", None)
+    if meta is None:
+        raise ValueError("model has no lm_meta — build it with "
+                         "TransformerLM(...) to generate")
+    num_layers, num_heads, max_len = (meta["num_layers"],
+                                      meta["num_heads"], meta["max_len"])
+    prompt = jnp.asarray(prompt)
+    b, p_len = prompt.shape
+    n_new = config.max_new_tokens
+    if p_len + n_new > max_len:
+        raise ValueError(f"prompt {p_len} + new {n_new} exceeds the "
+                         f"model's max_len {max_len}")
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    d_model = embed["tok"].shape[1]
+    head_dim = d_model // num_heads
+    # activations (and so the cache) follow the session dtype policy,
+    # mirroring the module forward path — token-exact parity with
+    # model.apply holds per-policy
+    dtype = activation_dtype()
+
+    ck = jnp.zeros((num_layers, b, max_len, num_heads, head_dim), dtype)
+    cv = jnp.zeros_like(ck)
+
+    # ---- prefill: run the prompt once, filling every layer's cache ----
+    x = _embed(embed, prompt, 0).astype(dtype)
+    pos = p_len - 1
+    for li in range(num_layers):
+        x, k_l, v_l = _block_step(blocks[li], x, ck[li], cv[li],
+                                  jnp.asarray(pos), num_heads, max_len)
+        ck = ck.at[li].set(k_l)
+        cv = cv.at[li].set(v_l)
+    logits = _logits(params, num_layers, x)
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32)
+        if config.temperature == 0.0:
+            return jnp.argmax(logits, axis=-1) + 1      # back to 1-based
+        logits = logits / config.temperature
+        if config.top_k is not None:
+            k_eff = min(config.top_k, logits.shape[-1])
+            kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
+            logits = jnp.where(logits < kth, -1e9, logits)
+        return jax.random.categorical(key, logits, axis=-1) + 1
+
+    rng, key0 = jax.random.split(rng)
+    first = sample(logits, key0)
+
+    # ---- decode: lax.scan over the remaining n_new - 1 positions ------
+    def step(carry, key):
+        tok, ck, cv, pos = carry
+        x = _embed(embed, tok[:, None], pos + 1).astype(dtype)
+        new_ck, new_cv = ck, cv
+        for li in range(num_layers):
+            x, k_l, v_l = _block_step(blocks[li], x, new_ck[li],
+                                      new_cv[li], pos + 1, num_heads,
+                                      max_len)
+            new_ck = new_ck.at[li].set(k_l)
+            new_cv = new_cv.at[li].set(v_l)
+        logits = _logits(params, num_layers, x)
+        nxt = sample(logits, key)
+        return (nxt, new_ck, new_cv, pos + 1), nxt
+
+    keys = jax.random.split(rng, max(n_new - 1, 1))
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, ck, cv, jnp.asarray(pos)), keys[:n_new - 1])
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return out

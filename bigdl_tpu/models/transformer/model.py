@@ -94,4 +94,8 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     model.add(nn.Linear(d_model, vocab_size,
                         init_method=init_mod.Xavier).set_name("lm_head"))
     model.add(nn.LogSoftMax())
+    # decode-path metadata (models/transformer/generate.py)
+    model.lm_meta = {"num_layers": num_layers, "num_heads": num_heads,
+                     "max_len": max_len, "d_model": d_model,
+                     "vocab": vocab_size}
     return model
