@@ -19,12 +19,10 @@ import io
 import queue
 import threading
 from pathlib import Path
-from typing import Iterator
 
 import numpy as np
 
-from bigdl_tpu.dataset.image.types import (LabeledBGRImage, LabeledGreyImage,
-                                           LabeledImage)
+from bigdl_tpu.dataset.image.types import (LabeledBGRImage, LabeledGreyImage)
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.utils.random import RandomGenerator

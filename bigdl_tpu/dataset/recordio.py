@@ -25,7 +25,7 @@ import json
 import os
 import struct
 from pathlib import Path
-from typing import Iterator, Sequence
+from typing import Iterator
 
 import numpy as np
 

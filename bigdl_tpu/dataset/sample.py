@@ -5,7 +5,7 @@ ByteRecord / Image / Sentence / Label (dataset/Types.scala:26-81).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np

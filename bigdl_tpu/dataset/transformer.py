@@ -15,7 +15,7 @@ from typing import Iterator
 
 import numpy as np
 
-from bigdl_tpu.dataset.sample import Sample, MiniBatch
+from bigdl_tpu.dataset.sample import MiniBatch
 
 __all__ = ["Transformer", "ChainedTransformer", "SampleToBatch"]
 

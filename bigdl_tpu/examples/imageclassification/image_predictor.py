@@ -20,7 +20,6 @@ import argparse
 import logging
 from pathlib import Path
 
-import numpy as np
 
 logger = logging.getLogger("bigdl_tpu.examples.imageclassification")
 

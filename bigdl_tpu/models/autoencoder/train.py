@@ -42,8 +42,12 @@ def main(argv=None):
 
     batch = args.batchSize or 150
     train = LocalArrayDataSet(mnist.load(
-        find(args.folder, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"]),
-        find(args.folder, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])))
+        find(args.folder,
+             ["train-images-idx3-ubyte",
+              "train-images.idx3-ubyte"]),
+        find(args.folder,
+             ["train-labels-idx1-ubyte",
+              "train-labels.idx1-ubyte"])))
     train_set = train >> GreyImgToReconstructionBatch(batch)
 
     model = (bfile.load_module(args.model) if args.model

@@ -24,8 +24,9 @@ from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Remat,
                           SpatialCrossMapLRN, SpatialMaxPooling, View)
 from bigdl_tpu.nn import init as init_mod
 
-__all__ = ["Inception_Layer_v1", "Inception_v1", "Inception_v1_NoAuxClassifier",
-           "Inception_Layer_v2", "Inception_v2", "Inception_v2_NoAuxClassifier"]
+__all__ = ["Inception_Layer_v1", "Inception_v1",
+           "Inception_v1_NoAuxClassifier", "Inception_Layer_v2",
+           "Inception_v2", "Inception_v2_NoAuxClassifier"]
 
 
 def Inception_Layer_v1(input_size, config, name_prefix=""):
@@ -90,7 +91,8 @@ def _v1_stem():
                  .set_name("conv2/3x3"))
             .add(ReLU().set_name("conv2/relu_3x3"))
             .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
-            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil()
+                 .set_name("pool2/3x3_s2")))
 
 
 def Inception_v1_NoAuxClassifier(class_num: int,
@@ -104,26 +106,26 @@ def Inception_v1_NoAuxClassifier(class_num: int,
     """
     wrap = Remat if remat else (lambda m: m)
     model = _v1_stem()
-    model.add(wrap(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
-                                 "inception_3a/")))
-    model.add(wrap(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
-                                 "inception_3b/")))
+    model.add(wrap(Inception_Layer_v1(
+        192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/")))
+    model.add(wrap(Inception_Layer_v1(
+        256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/")))
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
-    model.add(wrap(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
-                                 "inception_4a/")))
-    model.add(wrap(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
-                                 "inception_4b/")))
-    model.add(wrap(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
-                                 "inception_4c/")))
-    model.add(wrap(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
-                                 "inception_4d/")))
-    model.add(wrap(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
-                                 "inception_4e/")))
+    model.add(wrap(Inception_Layer_v1(
+        480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/")))
+    model.add(wrap(Inception_Layer_v1(
+        512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/")))
+    model.add(wrap(Inception_Layer_v1(
+        512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/")))
+    model.add(wrap(Inception_Layer_v1(
+        512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/")))
+    model.add(wrap(Inception_Layer_v1(
+        528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/")))
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
-    model.add(wrap(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
-                                 "inception_5a/")))
-    model.add(wrap(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
-                                 "inception_5b/")))
+    model.add(wrap(Inception_Layer_v1(
+        832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/")))
+    model.add(wrap(Inception_Layer_v1(
+        832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/")))
     model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
     model.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
     model.add(View(1024))
@@ -135,16 +137,17 @@ def Inception_v1_NoAuxClassifier(class_num: int,
 
 def Inception_v1(class_num: int) -> Sequential:
     """Full training graph with two auxiliary heads whose outputs concat
-    with the main head on the feature axis (reference Inception_v1.scala:96-176);
+    with the main head on the feature axis (reference
+    Inception_v1.scala:96-176);
     output shape (N, 3*classNum), head order [main, aux2, aux1]."""
     feature1 = _v1_stem()
-    feature1.add(Inception_Layer_v1(192, ((64,), (96, 128), (16, 32), (32,)),
-                                    "inception_3a/"))
-    feature1.add(Inception_Layer_v1(256, ((128,), (128, 192), (32, 96), (64,)),
-                                    "inception_3b/"))
+    feature1.add(Inception_Layer_v1(
+        192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+    feature1.add(Inception_Layer_v1(
+        256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
     feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
-    feature1.add(Inception_Layer_v1(480, ((192,), (96, 208), (16, 48), (64,)),
-                                    "inception_4a/"))
+    feature1.add(Inception_Layer_v1(
+        480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
 
     output1 = (Sequential()
                .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
@@ -163,15 +166,16 @@ def Inception_v1(class_num: int) -> Sequential:
                .add(LogSoftMax().set_name("loss1/loss")))
 
     feature2 = Sequential()
-    feature2.add(Inception_Layer_v1(512, ((160,), (112, 224), (24, 64), (64,)),
-                                    "inception_4b/"))
-    feature2.add(Inception_Layer_v1(512, ((128,), (128, 256), (24, 64), (64,)),
-                                    "inception_4c/"))
-    feature2.add(Inception_Layer_v1(512, ((112,), (144, 288), (32, 64), (64,)),
-                                    "inception_4d/"))
+    feature2.add(Inception_Layer_v1(
+        512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+    feature2.add(Inception_Layer_v1(
+        512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+    feature2.add(Inception_Layer_v1(
+        512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
 
     output2 = (Sequential()
-               .add(SpatialAveragePooling(5, 5, 3, 3).set_name("loss2/ave_pool"))
+               .add(SpatialAveragePooling(5, 5, 3, 3)
+                    .set_name("loss2/ave_pool"))
                .add(SpatialConvolution(528, 128, 1, 1, 1, 1,
                                        init_method=init_mod.Xavier)
                     .set_name("loss2/conv"))
@@ -186,13 +190,13 @@ def Inception_v1(class_num: int) -> Sequential:
                .add(LogSoftMax().set_name("loss2/loss")))
 
     output3 = Sequential()
-    output3.add(Inception_Layer_v1(528, ((256,), (160, 320), (32, 128), (128,)),
-                                   "inception_4e/"))
+    output3.add(Inception_Layer_v1(
+        528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
     output3.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
-    output3.add(Inception_Layer_v1(832, ((256,), (160, 320), (32, 128), (128,)),
-                                   "inception_5a/"))
-    output3.add(Inception_Layer_v1(832, ((384,), (192, 384), (48, 128), (128,)),
-                                   "inception_5b/"))
+    output3.add(Inception_Layer_v1(
+        832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+    output3.add(Inception_Layer_v1(
+        832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
     output3.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
     output3.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
     output3.add(View(1024))
@@ -251,7 +255,8 @@ def Inception_Layer_v2(input_size, config, name_prefix=""):
                     .set_name(name_prefix + "double3x3_reduce"))
                .add(SpatialBatchNormalization(config[2][0], 1e-3)
                     .set_name(name_prefix + "double3x3_reduce/bn"))
-               .add(ReLU().set_name(name_prefix + "double3x3_reduce/bn/sc/relu"))
+               .add(ReLU()
+                    .set_name(name_prefix + "double3x3_reduce/bn/sc/relu"))
                .add(SpatialConvolution(config[2][0], config[2][1], 3, 3,
                                        1, 1, 1, 1)
                     .set_name(name_prefix + "double3x3a"))
@@ -296,7 +301,8 @@ def _v2_stem():
             .add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, 1,
                                     propagate_back=False)
                  .set_name("conv1/7x7_s2"))
-            .add(SpatialBatchNormalization(64, 1e-3).set_name("conv1/7x7_s2/bn"))
+            .add(SpatialBatchNormalization(64, 1e-3)
+                 .set_name("conv1/7x7_s2/bn"))
             .add(ReLU().set_name("conv1/7x7_s2/bn/sc/relu"))
             .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
             .add(SpatialConvolution(64, 64, 1, 1).set_name("conv2/3x3_reduce"))
@@ -307,33 +313,35 @@ def _v2_stem():
                  .set_name("conv2/3x3"))
             .add(SpatialBatchNormalization(192, 1e-3).set_name("conv2/3x3/bn"))
             .add(ReLU().set_name("conv2/3x3/bn/sc/relu"))
-            .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
+            .add(SpatialMaxPooling(3, 3, 2, 2).ceil()
+                 .set_name("pool2/3x3_s2")))
 
 
 def Inception_v2_NoAuxClassifier(class_num: int) -> Sequential:
     """(reference Inception_v2.scala:105-148)"""
     model = _v2_stem()
-    model.add(Inception_Layer_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)),
-                                 "inception_3a/"))
-    model.add(Inception_Layer_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)),
-                                 "inception_3b/"))
-    model.add(Inception_Layer_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)),
-                                 "inception_3c/"))
-    model.add(Inception_Layer_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)),
-                                 "inception_4a/"))
-    model.add(Inception_Layer_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)),
-                                 "inception_4b/"))
-    model.add(Inception_Layer_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)),
-                                 "inception_4c/"))
-    model.add(Inception_Layer_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)),
-                                 "inception_4d/"))
-    model.add(Inception_Layer_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)),
-                                 "inception_4e/"))
-    model.add(Inception_Layer_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)),
-                                 "inception_5a/"))
-    model.add(Inception_Layer_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)),
-                                 "inception_5b/"))
-    model.add(SpatialAveragePooling(7, 7, 1, 1).ceil().set_name("pool5/7x7_s1"))
+    model.add(Inception_Layer_v2(
+        192, ((64,), (64, 64), (64, 96), ("avg", 32)), "inception_3a/"))
+    model.add(Inception_Layer_v2(
+        256, ((64,), (64, 96), (64, 96), ("avg", 64)), "inception_3b/"))
+    model.add(Inception_Layer_v2(
+        320, ((0,), (128, 160), (64, 96), ("max", 0)), "inception_3c/"))
+    model.add(Inception_Layer_v2(
+        576, ((224,), (64, 96), (96, 128), ("avg", 128)), "inception_4a/"))
+    model.add(Inception_Layer_v2(
+        576, ((192,), (96, 128), (96, 128), ("avg", 128)), "inception_4b/"))
+    model.add(Inception_Layer_v2(
+        576, ((160,), (128, 160), (128, 160), ("avg", 96)), "inception_4c/"))
+    model.add(Inception_Layer_v2(
+        576, ((96,), (128, 192), (160, 192), ("avg", 96)), "inception_4d/"))
+    model.add(Inception_Layer_v2(
+        576, ((0,), (128, 192), (192, 256), ("max", 0)), "inception_4e/"))
+    model.add(Inception_Layer_v2(
+        1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "inception_5a/"))
+    model.add(Inception_Layer_v2(
+        1024, ((352,), (192, 320), (192, 224), ("max", 128)), "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).ceil()
+              .set_name("pool5/7x7_s1"))
     model.add(View(1024))
     model.add(Linear(1024, class_num).set_name("loss3/classifier"))
     model.add(LogSoftMax().set_name("loss3/loss"))
@@ -345,12 +353,12 @@ def Inception_v2(class_num: int) -> Sequential:
     Inception_v2.scala:151-236); output (N, 3*classNum), heads
     [main, aux2, aux1]."""
     features1 = _v2_stem()
-    features1.add(Inception_Layer_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)),
-                                     "inception_3a/"))
-    features1.add(Inception_Layer_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)),
-                                     "inception_3b/"))
-    features1.add(Inception_Layer_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)),
-                                     "inception_3c/"))
+    features1.add(Inception_Layer_v2(
+        192, ((64,), (64, 64), (64, 96), ("avg", 32)), "inception_3a/"))
+    features1.add(Inception_Layer_v2(
+        256, ((64,), (64, 96), (64, 96), ("avg", 64)), "inception_3b/"))
+    features1.add(Inception_Layer_v2(
+        320, ((0,), (128, 160), (64, 96), ("max", 0)), "inception_3c/"))
 
     output1 = (Sequential()
                .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
@@ -367,16 +375,16 @@ def Inception_v2(class_num: int) -> Sequential:
                .add(LogSoftMax().set_name("loss1/loss")))
 
     features2 = Sequential()
-    features2.add(Inception_Layer_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)),
-                                     "inception_4a/"))
-    features2.add(Inception_Layer_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)),
-                                     "inception_4b/"))
-    features2.add(Inception_Layer_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)),
-                                     "inception_4c/"))
-    features2.add(Inception_Layer_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)),
-                                     "inception_4d/"))
-    features2.add(Inception_Layer_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)),
-                                     "inception_4e/"))
+    features2.add(Inception_Layer_v2(
+        576, ((224,), (64, 96), (96, 128), ("avg", 128)), "inception_4a/"))
+    features2.add(Inception_Layer_v2(
+        576, ((192,), (96, 128), (96, 128), ("avg", 128)), "inception_4b/"))
+    features2.add(Inception_Layer_v2(
+        576, ((160,), (128, 160), (128, 160), ("avg", 96)), "inception_4c/"))
+    features2.add(Inception_Layer_v2(
+        576, ((96,), (128, 192), (160, 192), ("avg", 96)), "inception_4d/"))
+    features2.add(Inception_Layer_v2(
+        576, ((0,), (128, 192), (192, 256), ("max", 0)), "inception_4e/"))
 
     output2 = (Sequential()
                .add(SpatialAveragePooling(5, 5, 3, 3).ceil()
@@ -393,11 +401,12 @@ def Inception_v2(class_num: int) -> Sequential:
                .add(LogSoftMax().set_name("loss2/loss")))
 
     output3 = Sequential()
-    output3.add(Inception_Layer_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)),
-                                   "inception_5a/"))
-    output3.add(Inception_Layer_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)),
-                                   "inception_5b/"))
-    output3.add(SpatialAveragePooling(7, 7, 1, 1).ceil().set_name("pool5/7x7_s1"))
+    output3.add(Inception_Layer_v2(
+        1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "inception_5a/"))
+    output3.add(Inception_Layer_v2(
+        1024, ((352,), (192, 320), (192, 224), ("max", 128)), "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1).ceil()
+                .set_name("pool5/7x7_s1"))
     output3.add(View(1024))
     output3.add(Linear(1024, class_num).set_name("loss3/classifier"))
     output3.add(LogSoftMax().set_name("loss3/loss"))

@@ -18,8 +18,12 @@ def main(argv=None):
     from bigdl_tpu.utils import file as bfile
 
     val = LocalArrayDataSet(mnist.load(
-        find(args.folder, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"]),
-        find(args.folder, ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])))
+        find(args.folder,
+             ["t10k-images-idx3-ubyte",
+              "t10k-images.idx3-ubyte"]),
+        find(args.folder,
+             ["t10k-labels-idx1-ubyte",
+              "t10k-labels.idx1-ubyte"])))
     val_set = val >> GreyImgNormalizer(mnist.TEST_MEAN, mnist.TEST_STD) \
         >> GreyImgToBatch(args.batchSize)
 

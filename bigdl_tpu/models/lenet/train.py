@@ -39,11 +39,19 @@ def main(argv=None):
 
     batch = args.batchSize or 128
     train = LocalArrayDataSet(mnist.load(
-        find(args.folder, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"]),
-        find(args.folder, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])))
+        find(args.folder,
+             ["train-images-idx3-ubyte",
+              "train-images.idx3-ubyte"]),
+        find(args.folder,
+             ["train-labels-idx1-ubyte",
+              "train-labels.idx1-ubyte"])))
     val = LocalArrayDataSet(mnist.load(
-        find(args.folder, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"]),
-        find(args.folder, ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])))
+        find(args.folder,
+             ["t10k-images-idx3-ubyte",
+              "t10k-images.idx3-ubyte"]),
+        find(args.folder,
+             ["t10k-labels-idx1-ubyte",
+              "t10k-labels.idx1-ubyte"])))
 
     train_set = train >> GreyImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD) \
         >> GreyImgToBatch(batch, drop_remainder=True)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.nn import (CAddTable, Concat, ConcatTable, Identity, Linear,
-                          LogSoftMax, MulConstant, ReLU, Sequential,
+                          MulConstant, ReLU, Sequential,
                           SpatialAveragePooling, SpatialBatchNormalization,
                           SpatialConvolution, SpatialMaxPooling, View)
 from bigdl_tpu.nn.module import Container, Module

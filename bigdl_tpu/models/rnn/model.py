@@ -15,7 +15,8 @@ from bigdl_tpu.nn import (Linear, LogSoftMax, Recurrent, RnnCell, Select,
 __all__ = ["SimpleRNN", "BatchedSimpleRNN"]
 
 
-def SimpleRNN(input_size: int, hidden_size: int, output_size: int) -> Sequential:
+def SimpleRNN(input_size: int, hidden_size: int,
+              output_size: int) -> Sequential:
     """(reference SimpleRNN.scala:22-35; batch-size-1 semantics)"""
     return (Sequential()
             .add(Recurrent(RnnCell(input_size, hidden_size, "tanh")))

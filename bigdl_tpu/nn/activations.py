@@ -292,7 +292,8 @@ class Scale(Module):
 
 class MulConstant(Module):
     """Multiply by a fixed scalar (reference nn/MulConstant.scala; used by
-    ResNet shortcut type A zero-padding branch, models/resnet/ResNet.scala:142-148)."""
+    ResNet shortcut type A zero-padding branch,
+    models/resnet/ResNet.scala:142-148)."""
 
     def __init__(self, constant_scalar: float, inplace: bool = False):
         super().__init__()

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import Module

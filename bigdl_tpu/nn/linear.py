@@ -22,7 +22,8 @@ class Linear(Module):
     """y = x W^T + b (reference nn/Linear.scala; default init
     stdv = 1/sqrt(inputSize))."""
 
-    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True,
                  init_method: str = init_mod.Default):
         super().__init__()
         self.input_size = input_size
@@ -105,7 +106,8 @@ class LookupTable(Module):
         idx = x.astype(jnp.int32) - 1  # reference is 1-based
         w = params["weight"]
         if self.max_norm is not None:
-            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1,
+                                    keepdims=True)
             w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
         y = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
         if self.padding_value:

@@ -64,7 +64,8 @@ class Module:
     # pure protocol — subclasses override
     # ------------------------------------------------------------------
     def init(self, rng) -> Any:
-        """Create the parameter pytree (dict of arrays; {} if parameterless)."""
+        """Create the parameter pytree (dict of arrays; {} when
+        parameterless)."""
         return {}
 
     def init_state(self) -> Any:
@@ -104,7 +105,8 @@ class Module:
     __call__ = forward
 
     def backward(self, x, grad_output, rng=None):
-        """Stateful backward via autodiff (reference AbstractModule.scala:162-169).
+        """Stateful backward via autodiff (reference
+        AbstractModule.scala:162-169).
 
         Computes grad wrt input (returned, like ``updateGradInput``) and
         *accumulates* parameter grads (like ``accGradParameters``).
@@ -260,7 +262,8 @@ class Module:
 class Container(Module):
     """Composite module (reference nn/Container.scala:29-138).
 
-    Child params/state are pytrees keyed by the child's position: ``{"0": ...}``.
+    Child params/state are pytrees keyed by the child's position:
+    ``{"0": ...}``.
     """
 
     def __init__(self, *modules: Module):
@@ -329,18 +332,21 @@ class Container(Module):
             self._rng = rng
             for i, m in enumerate(self.modules):
                 m.materialize(_fold(rng, i))
-            self.params = {str(i): m.params for i, m in enumerate(self.modules)}
+            self.params = {str(i): m.params
+                           for i, m in enumerate(self.modules)}
             self.state = {str(i): m.state for i, m in enumerate(self.modules)}
             self.grad_params = jax.tree.map(jnp.zeros_like, self.params)
         return self
 
     def __repr__(self):
-        inner = "\n".join(f"  ({i}): {m!r}" for i, m in enumerate(self.modules))
+        inner = "\n".join(f"  ({i}): {m!r}"
+                          for i, m in enumerate(self.modules))
         return f"{type(self).__name__}(\n{inner}\n)"
 
 
 class Criterion:
-    """Loss base (reference AbstractCriterion, nn/abstractnn/AbstractCriterion.scala:29-75).
+    """Loss base (reference AbstractCriterion,
+    nn/abstractnn/AbstractCriterion.scala:29-75).
 
     Pure protocol: ``loss = criterion.apply(input, target)`` (scalar).
     Stateful facade: ``forward`` caches output; ``backward`` returns

@@ -102,7 +102,8 @@ class BatchNormalization(Module):
 
 
 class SpatialBatchNormalization(BatchNormalization):
-    """4-D (N, C, H, W) wrapper (reference nn/SpatialBatchNormalization.scala)."""
+    """4-D (N, C, H, W) wrapper (reference
+    nn/SpatialBatchNormalization.scala)."""
 
     n_dim = 4
 

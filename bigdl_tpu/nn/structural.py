@@ -208,7 +208,8 @@ class JoinTable(Module):
 
 
 class SplitTable(Module):
-    """Split along ``dimension`` into a table (reference nn/SplitTable.scala)."""
+    """Split along ``dimension`` into a table (reference
+    nn/SplitTable.scala)."""
 
     def __init__(self, dimension: int, n_input_dims: int = -1):
         super().__init__()

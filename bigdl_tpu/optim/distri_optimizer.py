@@ -35,7 +35,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.dataset.dataset import to_jax_batch
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 

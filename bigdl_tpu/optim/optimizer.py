@@ -20,8 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.dataset.dataset import (AbstractDataSet, ShardedDataSet,
-                                       to_jax_batch)
+from bigdl_tpu.dataset.dataset import (to_jax_batch)
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod
 from bigdl_tpu.optim.sgd import SGD
@@ -51,7 +50,6 @@ class Optimizer:
 
     def __init__(self, model, dataset, criterion, batch_size=None, **kw):
         from bigdl_tpu.dataset.transformer import SampleToBatch
-        from bigdl_tpu.dataset.sample import Sample
         self.model = model
         if batch_size is not None:
             # RDD[Sample]+batchSize overload (reference :150-162)

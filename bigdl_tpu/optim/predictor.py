@@ -12,7 +12,7 @@ single ndarray.
 """
 from __future__ import annotations
 
-from typing import Iterable, Iterator
+from typing import Iterator
 
 import jax
 import numpy as np

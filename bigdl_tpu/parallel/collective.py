@@ -15,11 +15,10 @@ reproduces the reference's wire format exactly, natively on TPU.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 try:                                   # jax >= 0.8
     from jax import shard_map as _shard_map
 

@@ -19,7 +19,6 @@ slices, laid out by XLA from the sharding annotations.
 from __future__ import annotations
 
 import logging
-import os
 
 import jax
 import numpy as np

@@ -22,7 +22,6 @@ correctness never depends on divisibility.
 from __future__ import annotations
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.parallel.engine import get_mesh

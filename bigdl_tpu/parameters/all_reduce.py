@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from bigdl_tpu.parallel.engine import get_mesh
 from bigdl_tpu.parallel import collective as C
@@ -43,7 +42,8 @@ def slice_bounds(size: int, partition_num: int, pid: int) -> tuple[int, int]:
 class AllReduceParameter:
     """Collective-backed flat-parameter aggregation over the data axis."""
 
-    def __init__(self, partition_num: int | None = None, size: int | None = None,
+    def __init__(self, partition_num: int | None = None,
+                 size: int | None = None,
                  *, axis: str = "data", mesh=None,
                  wire_dtype=jnp.bfloat16):
         self.mesh = mesh or get_mesh()

@@ -24,7 +24,6 @@ Layout compatibility notes (why a flat copy is correct):
 from __future__ import annotations
 
 import logging
-import struct
 from typing import Iterator
 
 import numpy as np

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Dependency-free source linter (reference dev/lint-python role).
+
+The image ships no flake8/pycodestyle, so this is a small AST + text
+checker covering the rules that actually catch bugs in this codebase:
+
+- E999 syntax errors
+- F401 unused imports (module scope)
+- E501 lines over 79 characters
+- W191 tabs in indentation, W291 trailing whitespace
+- B006 mutable default arguments
+- E722 bare except
+
+Run: ``python dev/lint.py`` (exit 1 on findings). Scans bigdl_tpu/,
+tests/, dev/, bench.py, __graft_entry__.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["bigdl_tpu", "tests", "dev", "bench.py", "__graft_entry__.py"]
+MAX_LEN = 79
+
+
+def _files():
+    for t in TARGETS:
+        path = os.path.join(REPO, t)
+        if os.path.isfile(path):
+            yield path
+        else:
+            for root, _, names in os.walk(path):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield os.path.join(root, n)
+
+
+def _unused_imports(tree, src):
+    names = {}   # alias -> (line, name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                names[alias] = (node.lineno, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                alias = a.asname or a.name
+                names[alias] = (node.lineno, a.name)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            v = node
+            while isinstance(v, ast.Attribute):
+                v = v.value
+            if isinstance(v, ast.Name):
+                used.add(v.id)
+    # names re-exported via __all__ count as used
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "__all__"):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    used.add(c.value)
+    out = []
+    for alias, (line, name) in names.items():
+        if alias not in used and not alias.startswith("_"):
+            out.append((line, f"F401 unused import '{name}'"))
+    return out
+
+
+def lint_file(path):
+    rel = os.path.relpath(path, REPO)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    findings = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, f"E999 syntax error: {e.msg}")]
+    # package __init__ imports are re-exports (flake8's conventional
+    # F401-per-__init__ exemption)
+    if os.path.basename(path) != "__init__.py":
+        findings += [(rel, ln, msg)
+                     for ln, msg in _unused_imports(tree, src)]
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" in line:
+            continue
+        if len(line) > MAX_LEN and "http://" not in line \
+                and "https://" not in line:
+            findings.append((rel, i, f"E501 line too long ({len(line)})"))
+        if line != line.rstrip():
+            findings.append((rel, i, "W291 trailing whitespace"))
+        if line.startswith("\t") or (line[:1] == " " and "\t" in
+                                     line[:len(line) - len(line.lstrip())]):
+            findings.append((rel, i, "W191 tab in indentation"))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        (rel, d.lineno, "B006 mutable default argument"))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append((rel, node.lineno, "E722 bare except"))
+    return findings
+
+
+def main():
+    all_findings = []
+    for path in _files():
+        all_findings.extend(lint_file(path))
+    for rel, line, msg in all_findings:
+        print(f"{rel}:{line}: {msg}")
+    print(f"{len(all_findings)} finding(s)")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

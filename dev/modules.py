@@ -1,0 +1,28 @@
+"""Test-module registry (reference dev/modules.py:22-60 role).
+
+Maps logical framework areas to their test files so ``dev/run_tests.py``
+can run a slice (`--modules nn,optim`) the way the reference's python
+runner selects registered modules.
+"""
+
+MODULES = {
+    "nn": ["tests/test_nn_layers.py", "tests/test_nn_layers_extended.py",
+           "tests/test_criterions.py", "tests/test_recurrent.py",
+           "tests/test_gradient_check.py", "tests/test_remat.py"],
+    "tensor": ["tests/test_ref_oracle.py", "tests/test_golden_fixtures.py"],
+    "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
+                "tests/test_native_loader.py"],
+    "optim": ["tests/test_optim.py", "tests/test_checkpoint.py",
+              "tests/test_predictor.py"],
+    "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",
+                 "tests/test_tensor_parallel.py",
+                 "tests/test_pipeline_parallel.py",
+                 "tests/test_expert_parallel.py",
+                 "tests/test_sequence_parallel.py",
+                 "tests/test_flash_attention.py"],
+    "models": ["tests/test_models.py", "tests/test_transformer.py",
+               "tests/test_generate.py", "tests/test_perf_paths.py"],
+    "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],
+    "examples": ["tests/test_examples.py",
+                 "tests/test_textclassification.py"],
+}

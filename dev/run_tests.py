@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Module-registry test runner (reference dev/run-tests.py role).
+
+Usage::
+
+    python dev/run_tests.py                   # everything
+    python dev/run_tests.py --modules nn,optim
+    python dev/run_tests.py --list
+
+Runs pytest per selected module group and reports a summary table, the
+way the reference's python runner iterates its registered modules.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from modules import MODULES  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--modules", default=None,
+                        help="comma-separated module names (default: all)")
+    parser.add_argument("--list", action="store_true")
+    parser.add_argument("-x", "--exitfirst", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, files in MODULES.items():
+            print(f"{name}: {' '.join(files)}")
+        return 0
+
+    names = (args.modules.split(",") if args.modules else list(MODULES))
+    unknown = [n for n in names if n not in MODULES]
+    if unknown:
+        print(f"unknown modules: {unknown}; known: {sorted(MODULES)}")
+        return 2
+
+    results = []
+    for name in names:
+        missing = [f for f in MODULES[name]
+                   if not os.path.exists(os.path.join(REPO, f))]
+        if missing:
+            print(f"module '{name}' registers missing test files: "
+                  f"{missing} (fix dev/modules.py)")
+            return 2
+        cmd = [sys.executable, "-m", "pytest", "-q", *MODULES[name]]
+        if args.exitfirst:
+            cmd.append("-x")
+        t0 = time.time()
+        rc = subprocess.call(cmd, cwd=REPO)
+        results.append((name, rc, time.time() - t0))
+        if rc and args.exitfirst:
+            break
+
+    print("\n== summary ==")
+    failed = False
+    for name, rc, dt in results:
+        status = "OK" if rc == 0 else f"FAILED (rc={rc})"
+        print(f"  {name:10s} {status}  ({dt:.1f}s)")
+        failed = failed or rc != 0
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

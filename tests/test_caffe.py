@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from bigdl_tpu import nn
-from bigdl_tpu.utils.caffe import (CaffeLoader, load_caffe, parse_caffemodel,
+from bigdl_tpu.utils.caffe import (load_caffe, parse_caffemodel,
                                    parse_prototxt)
 
 RES = Path(__file__).parent / "resources" / "caffe"

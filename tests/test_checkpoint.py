@@ -65,7 +65,8 @@ def _run(total_iters, ckpt_dir=None, ckpt_every=None, resume_from=None,
         ds = make_dataset(num_shards=1 if distri else None) \
             >> SampleToBatch(16, drop_remainder=True)
         if resume_from is not None:
-            model = bfile.load_module(f"{resume_from[0]}/model.{resume_from[1]}")
+            model = bfile.load_module(
+                f"{resume_from[0]}/model.{resume_from[1]}")
             state = bfile.load(f"{resume_from[0]}/state.{resume_from[1]}")
         else:
             model = make_model()

@@ -81,7 +81,8 @@ class TestAbsSmoothL1:
         x = RS.randn(4, 3).astype(np.float32)
         y = RS.randn(4, 3).astype(np.float32)
         assert_close(nn.AbsCriterion().forward(jnp.asarray(x), jnp.asarray(y)),
-                     F.l1_loss(torch.from_numpy(x), torch.from_numpy(y)).item())
+                     F.l1_loss(torch.from_numpy(x),
+                               torch.from_numpy(y)).item())
 
     def test_smooth_l1(self):
         x = RS.randn(4, 3).astype(np.float32)

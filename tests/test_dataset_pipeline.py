@@ -1,4 +1,5 @@
-"""Image/text pipeline tests (mirrors reference dataset/ specs — SURVEY §4.6)."""
+"""Image/text pipeline tests (mirrors the reference dataset specs,
+SURVEY §4.6)."""
 import gzip
 import io
 import struct
@@ -9,7 +10,7 @@ import pytest
 from bigdl_tpu.dataset import mnist, cifar
 from bigdl_tpu.dataset.image import (
     BGRImgCropper, BGRImgNormalizer, BGRImgRdmCropper, BGRImgToBatch,
-    BytesToBGRImg, ColorJitter, CropCenter, GreyImgNormalizer, GreyImgToBatch,
+    BytesToBGRImg, ColorJitter, CropCenter, GreyImgToBatch,
     HFlip, LabeledBGRImage, LabeledGreyImage, Lighting, MTImgToBatch)
 from bigdl_tpu.dataset.sample import ByteRecord
 from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
@@ -32,7 +33,8 @@ class TestImageTransforms:
         assert all(o.content.shape == (8, 8, 3) for o in out)
         # center crop is deterministic: top-left (1, 2)
         np.testing.assert_array_equal(out[0].content,
-                                      bgr_images(h=10, w=12)[0].content[1:9, 2:10])
+                                      bgr_images(h=10, w=12)[0]
+                                      .content[1:9, 2:10])
 
     def test_random_crop_bounds(self):
         RandomGenerator.set_seed(7)
@@ -135,7 +137,8 @@ class TestImageTransforms:
         sizes = [b.data.shape[0] for b in out]
         assert sizes == [4, 4, 4, 4, 4, 2]
         labels = np.concatenate([b.labels for b in out])
-        np.testing.assert_array_equal(labels, np.arange(1, 23, dtype=np.float32))
+        np.testing.assert_array_equal(
+            labels, np.arange(1, 23, dtype=np.float32))
 
     def test_mt_batch_workers_draw_distinct_random_streams(self):
         """Random augmentation must differ across worker threads (shared

@@ -6,7 +6,6 @@ whole models and over the layers that carry HAND-WRITTEN backwards
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from bigdl_tpu import nn
 

@@ -8,10 +8,9 @@ marked slow-ish but still CPU-feasible.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from bigdl_tpu import models
-from bigdl_tpu.nn import ClassNLLCriterion, MSECriterion
+from bigdl_tpu.nn import ClassNLLCriterion
 
 
 def fwd(model, x, training=False):
@@ -65,7 +64,8 @@ class TestAutoencoder:
 class TestInception:
     def test_v1_no_aux_shape(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
-        assert fwd(models.Inception_v1_NoAuxClassifier(100), x).shape == (1, 100)
+        assert fwd(models.Inception_v1_NoAuxClassifier(100),
+                   x).shape == (1, 100)
 
     def test_v1_aux_heads_concat(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
@@ -110,7 +110,8 @@ class TestResNet:
 
     def test_imagenet_bottleneck(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
-        m = models.ResNet(7, {"depth": 50, "dataset": models.DatasetType.ImageNet})
+        m = models.ResNet(7, {"depth": 50,
+                              "dataset": models.DatasetType.ImageNet})
         assert fwd(m, x).shape == (1, 7)
 
     def test_shortcut_type_a_zero_pads(self):

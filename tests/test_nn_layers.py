@@ -55,8 +55,10 @@ class TestLinear:
         gout = np.ones((4, 3), np.float32)
         gin = m.backward(jnp.asarray(x), jnp.asarray(gout))
         xt = torch.from_numpy(x).requires_grad_(True)
-        wt = torch.from_numpy(np.asarray(m.params["weight"])).requires_grad_(True)
-        bt = torch.from_numpy(np.asarray(m.params["bias"])).requires_grad_(True)
+        wt = torch.from_numpy(
+            np.asarray(m.params["weight"])).requires_grad_(True)
+        bt = torch.from_numpy(
+            np.asarray(m.params["bias"])).requires_grad_(True)
         F.linear(xt, wt, bt).backward(torch.from_numpy(gout))
         assert_close(gin, t2n(xt.grad))
         assert_close(m.grad_params["weight"], t2n(wt.grad))

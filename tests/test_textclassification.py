@@ -2,7 +2,6 @@
 BASELINE tracked config #5). Synthetic 3-class corpus + tiny GloVe file;
 the real 20 Newsgroups run uses the same code path at scale."""
 import numpy as np
-import pytest
 
 from bigdl_tpu.examples.textclassification import (
     TextClassifier, build_model, shaping, to_tokens, vectorization)
