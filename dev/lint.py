@@ -37,9 +37,11 @@ def _files():
                         yield os.path.join(root, n)
 
 
-def _unused_imports(tree, src):
+def _unused_imports(tree):
     names = {}   # alias -> (line, name)
-    for node in ast.walk(tree):
+    # module scope only: function-local imports are deliberate lazy
+    # loads here, and a local alias must not mask a dead module-level one
+    for node in tree.body:
         if isinstance(node, ast.Import):
             for a in node.names:
                 alias = a.asname or a.name.split(".")[0]
@@ -90,7 +92,7 @@ def lint_file(path):
     # F401-per-__init__ exemption)
     if os.path.basename(path) != "__init__.py":
         findings += [(rel, ln, msg)
-                     for ln, msg in _unused_imports(tree, src)]
+                     for ln, msg in _unused_imports(tree)]
     for i, line in enumerate(src.splitlines(), 1):
         if "# noqa" in line:
             continue

@@ -43,6 +43,16 @@ def main(argv=None):
         print(f"unknown modules: {unknown}; known: {sorted(MODULES)}")
         return 2
 
+    registered = {f for files in MODULES.values() for f in files}
+    import glob
+    on_disk = {os.path.relpath(f, REPO).replace(os.sep, "/")
+               for f in glob.glob(os.path.join(REPO, "tests",
+                                               "test_*.py"))}
+    stray = sorted(on_disk - registered)
+    if stray:
+        print(f"tests on disk not registered in dev/modules.py: {stray}")
+        return 2
+
     results = []
     for name in names:
         missing = [f for f in MODULES[name]

@@ -133,3 +133,35 @@ def test_zero1_trains_identically_to_replicated():
 
     for a, b in zip(jax.tree.leaves(run(False)), jax.tree.leaves(run(True))):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_transformer_lm_trains_identically_to_replicated():
+    """The GSPMD layout rules are model-agnostic: a TransformerLM
+    (embedding rows, attention/ffn matrices column-parallel) under dp x tp
+    must train to the SAME parameters as replicated."""
+    def _train_lm(tp):
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 2, "model": 4})
+        rng = np.random.default_rng(0)
+        V, S, B = 32, 16, 8
+        data = rng.integers(1, V + 1, size=(B, S))
+        labels = np.roll(data, -1, axis=1)
+        from bigdl_tpu.models import TransformerLM
+        model = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                              max_len=S)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        opt = DistriOptimizer(
+            model, ds.iterator_source(
+                lambda: iter([MiniBatch(data, labels)]), size=B),
+            crit, mesh=mesh, tensor_parallel=tp)
+        opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+        opt.set_end_when(max_iteration(3))
+        trained = opt.optimize()
+        Engine.reset()
+        return jax.tree.map(np.asarray, trained.params)
+
+    p_repl = _train_lm(False)
+    p_tp = _train_lm(True)
+    for a, b in zip(jax.tree.leaves(p_repl), jax.tree.leaves(p_tp)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
