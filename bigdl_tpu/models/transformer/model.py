@@ -81,8 +81,14 @@ class _TokenAndPosition(Module):
 def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
                   num_layers: int = 2, max_len: int = 512,
                   ffn_mult: int = 4, dropout: float = 0.0,
-                  sequence_parallel: str | None = None) -> nn.Sequential:
-    """Causal LM: tokens (B, S) -> log-probs (B, S, vocab)."""
+                  sequence_parallel: str | None = None,
+                  with_log_softmax: bool = True) -> nn.Sequential:
+    """Causal LM: tokens (B, S) -> log-probs (B, S, vocab).
+
+    ``with_log_softmax=False`` ends at raw logits — pair it with
+    ``CrossEntropyCriterion`` to skip materializing the f32 log-prob
+    tensor (the memory-lean LM training recipe, docs/PERF.md).
+    """
     model = (nn.Sequential()
              .add(_TokenAndPosition(vocab_size, d_model, max_len)
                   .set_name("embed")))
@@ -93,7 +99,8 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     model.add(nn.LayerNorm(d_model).set_name("final_norm"))
     model.add(nn.Linear(d_model, vocab_size,
                         init_method=init_mod.Xavier).set_name("lm_head"))
-    model.add(nn.LogSoftMax())
+    if with_log_softmax:
+        model.add(nn.LogSoftMax())
     # decode-path metadata (models/transformer/generate.py)
     model.lm_meta = {"num_layers": num_layers, "num_heads": num_heads,
                      "max_len": max_len, "d_model": d_model,

@@ -84,12 +84,15 @@ def _transformer_perf(args):
                                compute_dtype=jnp.bfloat16,
                                activation_dtype=jnp.bfloat16))
     vocab, s, b = args.classNum, args.seqLen, args.batchSize
+    # logits head + lse-form CrossEntropy (the memory-lean recipe);
+    # size-averaged loss and a sane lr keep the synthetic run finite
     model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
-                          max_len=s)
+                          max_len=s, with_log_softmax=False)
     model.materialize(jax.random.PRNGKey(0))
     model.training()
-    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
-    optim = SGD(learning_rate=0.1)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
+                                       size_average=True)
+    optim = SGD(learning_rate=0.01)
     params, mstate = model.params, model.state
     opt_state = optim.init_state(params)
 
@@ -114,12 +117,15 @@ def _transformer_perf(args):
     for _ in range(args.iteration):
         params, mstate, opt_state, loss = c(params, mstate, opt_state,
                                             data, labels)
-    float(loss)
+    final = float(loss)
     dt = time.perf_counter() - t0
+    if not np.isfinite(final):
+        raise SystemExit(f"transformer perf run diverged: loss={final} "
+                         f"(throughput would be meaningless)")
     cost = c.cost_analysis()
     line = (f"transformer: {b * s * args.iteration / dt:,.0f} tokens/s "
             f"({dt / args.iteration * 1000:.1f} ms/step, B{b} S{s} "
-            f"vocab {vocab})")
+            f"vocab {vocab}, final loss {final:.3f})")
     if cost and cost.get("flops"):
         line += (f" [{cost['flops'] * args.iteration / dt / 1e12:.1f} "
                  f"TFLOP/s achieved]")

@@ -37,6 +37,16 @@ def _avg(v, n, size_average):
     return v / n if size_average else v
 
 
+def _nll_reduce(per, t, weights, size_average):
+    """Shared NLL reduction: ``per`` is the per-sample loss, ``t`` the
+    0-based class index (for per-class weights)."""
+    if weights is not None:
+        w = jnp.take(weights, t)
+        total = jnp.sum(w * per)
+        return total / jnp.sum(w) if size_average else total
+    return _avg(jnp.sum(per), t.shape[0], size_average)
+
+
 class ClassNLLCriterion(Criterion):
     """NLL over log-probabilities; 1-based integer targets
     (reference nn/ClassNLLCriterion.scala, threaded per sample)."""
@@ -50,11 +60,7 @@ class ClassNLLCriterion(Criterion):
         t = target.astype(jnp.int32).reshape(-1) - 1
         logp = x.reshape(-1, x.shape[-1])
         picked = jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
-        if self.weights is not None:
-            w = jnp.take(self.weights, t)
-            total = -jnp.sum(w * picked)
-            return total / jnp.sum(w) if self.size_average else total
-        return _avg(-jnp.sum(picked), t.shape[0], self.size_average)
+        return _nll_reduce(-picked, t, self.weights, self.size_average)
 
 
 class MSECriterion(Criterion):
@@ -99,16 +105,27 @@ class BCECriterion(Criterion):
 class CrossEntropyCriterion(Criterion):
     """LogSoftMax + ClassNLL fused (reference nn/CrossEntropyCriterion.scala).
 
-    TPU note: fusing keeps one softmax on-chip instead of materializing
-    log-probs — same as the reference's composition but numerically via
-    ``log_softmax``."""
+    TPU note: computed as ``logsumexp(x) - x[target]`` rather than
+    composing ``log_softmax`` + NLL: the composition materializes the
+    (N, V) log-prob tensor in f32 as a saved residual, while the lse
+    form's backward is ``softmax(x) - onehot`` fused into the one
+    cotangent buffer that must exist anyway — at LM vocab sizes this is
+    the difference between several extra (B, S, V) buffers and none
+    (docs/PERF.md transformer section)."""
 
     def __init__(self, weights=None, size_average: bool = True):
         super().__init__()
-        self.nll = ClassNLLCriterion(weights, size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
 
     def apply(self, x, target):
-        return self.nll.apply(jax.nn.log_softmax(x, axis=-1), target)
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        logits = x.reshape(-1, x.shape[-1]).astype(
+            jnp.promote_types(x.dtype, jnp.float32))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
+        return _nll_reduce(lse - picked, t, self.weights,
+                           self.size_average)
 
 
 class ClassSimplexCriterion(Criterion):

@@ -245,3 +245,18 @@ def test_time_distributed_vmap_matches_explicit_loop():
     want = sum(float(nn.MSECriterion().apply(p[:, i], y[:, i]))
                for i in range(6))
     np.testing.assert_allclose(float(c.apply(p, y)), want, rtol=1e-5)
+
+
+def test_weighted_cross_entropy_matches_torch():
+    """The lse-form CrossEntropyCriterion's weighted reduction (review
+    r2: previously delegated to ClassNLL, now shared via _nll_reduce)."""
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((8, 5)).astype(np.float32)
+    t = rng.integers(1, 6, size=(8,))
+    w = rng.uniform(0.5, 2.0, size=(5,)).astype(np.float32)
+    for size_average, red in ((True, "mean"), (False, "sum")):
+        c = nn.CrossEntropyCriterion(weights=w, size_average=size_average)
+        got = float(c.apply(jnp.asarray(x), jnp.asarray(t)))
+        want = F.cross_entropy(torch.tensor(x), torch.tensor(t - 1),
+                               weight=torch.tensor(w), reduction=red)
+        np.testing.assert_allclose(got, float(want), rtol=1e-5)
