@@ -1,7 +1,7 @@
 from bigdl_tpu.models.transformer.generate import (GenerationConfig,
-                                                    generate)
+                                                    beam_search, generate)
 from bigdl_tpu.models.transformer.model import (TransformerBlock,
                                                 TransformerLM)
 
 __all__ = ["TransformerBlock", "TransformerLM", "GenerationConfig",
-           "generate"]
+           "generate", "beam_search"]

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
-__all__ = ["generate", "GenerationConfig"]
+__all__ = ["generate", "beam_search", "GenerationConfig"]
 
 
 class GenerationConfig:
@@ -133,6 +133,38 @@ def _logits(params, num_layers, x):
     return _linear(head, _ln(norm, x[:, -1]))
 
 
+def _setup_and_prefill(model, prompt, n_new, params):
+    """Shared decode preamble: meta checks, cache allocation, and the
+    prompt prefill pass. Returns (params, meta dims, caches, last-layer
+    activations, pos0)."""
+    params = model.params if params is None else params
+    meta = getattr(model, "lm_meta", None)
+    if meta is None:
+        raise ValueError("model has no lm_meta — build it with "
+                         "TransformerLM(...) to generate")
+    num_layers, num_heads, max_len = (meta["num_layers"],
+                                      meta["num_heads"], meta["max_len"])
+    prompt = jnp.asarray(prompt)
+    b, p_len = prompt.shape
+    if p_len + n_new > max_len:
+        raise ValueError(f"prompt {p_len} + new {n_new} exceeds the "
+                         f"model's max_len {max_len}")
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    head_dim = embed["tok"].shape[1] // num_heads
+    dtype = activation_dtype()
+    ck = jnp.zeros((num_layers, b, max_len, num_heads, head_dim), dtype)
+    cv = jnp.zeros_like(ck)
+    x = _embed(embed, prompt, 0).astype(dtype)
+    pos0 = p_len - 1
+    for li in range(num_layers):
+        x, k_l, v_l = _block_step(blocks[li], x, ck[li], cv[li],
+                                  jnp.asarray(pos0), num_heads, max_len)
+        ck = ck.at[li].set(k_l)
+        cv = cv.at[li].set(v_l)
+    return (params, prompt, num_layers, num_heads, max_len, embed,
+            blocks, dtype, ck, cv, x, pos0)
+
+
 def generate(model, prompt, config: GenerationConfig | None = None, *,
              rng=None, params=None):
     """Decode ``config.max_new_tokens`` tokens after ``prompt`` (B, P)
@@ -143,38 +175,14 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
     ``params`` to decode with externally-updated parameters.
     """
     config = config or GenerationConfig()
-    params = model.params if params is None else params
-    meta = getattr(model, "lm_meta", None)
-    if meta is None:
-        raise ValueError("model has no lm_meta — build it with "
-                         "TransformerLM(...) to generate")
-    num_layers, num_heads, max_len = (meta["num_layers"],
-                                      meta["num_heads"], meta["max_len"])
-    prompt = jnp.asarray(prompt)
-    b, p_len = prompt.shape
     n_new = config.max_new_tokens
-    if p_len + n_new > max_len:
-        raise ValueError(f"prompt {p_len} + new {n_new} exceeds the "
-                         f"model's max_len {max_len}")
-    embed, blocks, _, _ = _model_parts(params, num_layers)
-    d_model = embed["tok"].shape[1]
-    head_dim = d_model // num_heads
-    # activations (and so the cache) follow the session dtype policy,
+    # activations (and the cache) follow the session dtype policy,
     # mirroring the module forward path — token-exact parity with
     # model.apply holds per-policy
-    dtype = activation_dtype()
-
-    ck = jnp.zeros((num_layers, b, max_len, num_heads, head_dim), dtype)
-    cv = jnp.zeros_like(ck)
-
-    # ---- prefill: run the prompt once, filling every layer's cache ----
-    x = _embed(embed, prompt, 0).astype(dtype)
-    pos = p_len - 1
-    for li in range(num_layers):
-        x, k_l, v_l = _block_step(blocks[li], x, ck[li], cv[li],
-                                  jnp.asarray(pos), num_heads, max_len)
-        ck = ck.at[li].set(k_l)
-        cv = cv.at[li].set(v_l)
+    (params, prompt, num_layers, num_heads, max_len, embed, blocks,
+     dtype, ck, cv, x, pos) = _setup_and_prefill(model, prompt, n_new,
+                                                 params)
+    b = prompt.shape[0]
     logits = _logits(params, num_layers, x)
 
     if rng is None:
@@ -214,3 +222,101 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
         step, (first, ck, cv, jnp.asarray(pos)), keys[:n_new - 1])
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return out
+
+
+def beam_search(model, prompt, *, num_beams: int = 4,
+                max_new_tokens: int = 32, length_penalty: float = 1.0,
+                eos_id: int | None = None, params=None):
+    """Length-normalized beam search with the same static KV cache.
+
+    Returns ``(tokens, scores)``: (B, num_beams, max_new_tokens) 1-based
+    ids and (B, num_beams) total log-probabilities divided by
+    ``n_tokens ** length_penalty``, beams sorted best-first. Beams that
+    emit ``eos_id`` freeze (their score stops accumulating; the eos
+    position is part of the output).
+
+    Beams fold into the batch dim (B*K rows) so every step is the same
+    single-token cache step as ``generate``; each step's top-k reorders
+    beam histories AND cache rows with one gather.
+    """
+    k = num_beams
+    n_new = max_new_tokens
+    (params, prompt, num_layers, num_heads, max_len, embed, blocks,
+     dtype, ck, cv, x, pos0) = _setup_and_prefill(model, prompt, n_new,
+                                                  params)
+    b = prompt.shape[0]
+    logp0 = jax.nn.log_softmax(
+        _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
+
+    # first expansion: top-k of the single distribution seeds the beams
+    # (k > vocab: seed the extra beams at -inf; the next step's top-k
+    # over k*vocab candidates never selects them)
+    k0 = min(k, vocab := embed["tok"].shape[0])
+    scores, tok0 = jax.lax.top_k(logp0, k0)           # (B, k0) each
+    if k0 < k:
+        scores = jnp.pad(scores, ((0, 0), (0, k - k0)),
+                         constant_values=-jnp.inf)
+        tok0 = jnp.pad(tok0, ((0, 0), (0, k - k0)))
+    tok0 = tok0 + 1                                   # back to 1-based
+    finished = (tok0 == eos_id) if eos_id is not None \
+        else jnp.zeros((b, k), bool)
+    lengths = jnp.ones((b, k), jnp.float32)   # real tokens incl. eos
+    history = jnp.zeros((b, k, n_new), jnp.int32)
+    history = history.at[:, :, 0].set(tok0)
+
+    # beams share the prompt cache: tile rows to (L, B*K, M, H, Dh)
+    ck = jnp.repeat(ck, k, axis=1)
+    cv = jnp.repeat(cv, k, axis=1)
+    batch_offset = (jnp.arange(b) * k)[:, None]       # (B, 1)
+
+    def step(carry, i):
+        tok, ck, cv, scores, finished, lengths, history = carry
+        # the token fed was produced at step i-1: absolute position
+        # p_len + i - 1 = pos0 + i
+        pos = pos0 + i
+        x = _embed(embed, tok.reshape(b * k, 1), pos).astype(dtype)
+        new_ck, new_cv = ck, cv
+        for li in range(num_layers):
+            x, k_l, v_l = _block_step(blocks[li], x, new_ck[li],
+                                      new_cv[li], pos, num_heads,
+                                      max_len)
+            new_ck = new_ck.at[li].set(k_l)
+            new_cv = new_cv.at[li].set(v_l)
+        logp = jax.nn.log_softmax(
+            _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
+        logp = logp.reshape(b, k, vocab)
+        # frozen beams contribute exactly one continuation (token 1,
+        # score unchanged) so they occupy one top-k slot, not V
+        frozen = jnp.full((vocab,), -jnp.inf).at[0].set(0.0)
+        logp = jnp.where(finished[..., None], frozen[None, None], logp)
+        cand = (scores[..., None] + logp).reshape(b, k * vocab)
+        scores, flat = jax.lax.top_k(cand, k)         # (B, K)
+        beam_idx = flat // vocab                      # (B, K) source beam
+        tok_new = flat % vocab + 1                    # 1-based
+        # reorder histories and caches to the chosen source beams
+        history = jnp.take_along_axis(history, beam_idx[..., None],
+                                      axis=1)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        # frozen beams emit padding id 0, not a real token
+        history = history.at[:, :, i].set(
+            jnp.where(finished, 0, tok_new))
+        lengths = lengths + jnp.where(finished, 0.0, 1.0)
+        if eos_id is not None:
+            finished = finished | (tok_new == eos_id)
+        rows = (batch_offset + beam_idx).reshape(-1)  # (B*K,)
+        new_ck = new_ck[:, rows]
+        new_cv = new_cv[:, rows]
+        return (tok_new, new_ck, new_cv, scores, finished, lengths,
+                history), None
+
+    if n_new > 1:
+        (tok, ck, cv, scores, finished, lengths, history), _ = \
+            jax.lax.scan(step, (tok0, ck, cv, scores, finished, lengths,
+                                history), jnp.arange(1, n_new))
+    # normalize by each beam's ACTUAL emitted length (eos-frozen beams
+    # stop growing), so length_penalty genuinely reorders beams
+    norm = scores / (lengths ** length_penalty)
+    order = jnp.argsort(-norm, axis=1)
+    return (jnp.take_along_axis(history, order[..., None], axis=1),
+            jnp.take_along_axis(norm, order, axis=1))

@@ -128,3 +128,94 @@ def test_top_k_larger_than_vocab_keeps_full_support():
                                                top_k=VOCAB * 10),
                               rng=jax.random.PRNGKey(0)))
     assert ((out >= 1) & (out <= VOCAB)).all()
+
+
+def test_beam_one_equals_greedy():
+    from bigdl_tpu.models.transformer import beam_search
+    m = _model()
+    prompt = np.random.default_rng(10).integers(1, VOCAB + 1, size=(2, 5))
+    greedy = np.asarray(generate(m, prompt, GenerationConfig(7)))
+    beams, scores = beam_search(m, prompt, num_beams=1, max_new_tokens=7)
+    np.testing.assert_array_equal(np.asarray(beams)[:, 0], greedy)
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_scores_are_true_sequence_logprobs_and_sorted():
+    """Returned score * n == teacher-forced sum of token log-probs, and
+    beams come back best-first."""
+    from bigdl_tpu.models.transformer import beam_search
+    m = _model(4)
+    B, P, N, K = 2, 4, 5, 3
+    prompt = np.random.default_rng(11).integers(1, VOCAB + 1, size=(B, P))
+    beams, scores = beam_search(m, prompt, num_beams=K, max_new_tokens=N)
+    beams, scores = np.asarray(beams), np.asarray(scores)
+    assert np.all(np.diff(scores, axis=1) <= 1e-6)   # sorted descending
+    for bi in range(B):
+        for ki in range(K):
+            seq = np.concatenate([prompt[bi], beams[bi, ki]])
+            logp, _ = m.apply(m.params, m.state,
+                              jnp.asarray(seq[None, :]))
+            logp = np.asarray(logp, np.float64)
+            total = sum(logp[0, P - 1 + t, beams[bi, ki][t] - 1]
+                        for t in range(N))
+            np.testing.assert_allclose(scores[bi, ki] * N, total,
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_wide_beam_finds_exhaustive_optimum():
+    """With K >= V^(n-1), the search keeps every prefix, so its top beam
+    must equal the brute-force argmax over all V^n continuations."""
+    from bigdl_tpu.models.transformer import beam_search
+    import itertools
+    V, N, K = 5, 3, 25
+    m = TransformerLM(V, d_model=16, num_heads=2, num_layers=1, max_len=16)
+    m.materialize(jax.random.PRNGKey(6))
+    m.evaluate()
+    m_prompt = np.array([[1, 2]])
+    best, best_seq = -np.inf, None
+    for seq in itertools.product(range(1, V + 1), repeat=N):
+        full = np.concatenate([m_prompt[0], np.array(seq)])
+        logp = np.asarray(m.apply(m.params, m.state,
+                                  jnp.asarray(full[None]))[0], np.float64)
+        total = sum(logp[0, 1 + t, seq[t] - 1] for t in range(N))
+        if total > best:
+            best, best_seq = total, seq
+    beams, scores = beam_search(m, m_prompt, num_beams=K, max_new_tokens=N)
+    np.testing.assert_array_equal(np.asarray(beams)[0, 0],
+                                  np.array(best_seq))
+    np.testing.assert_allclose(float(np.asarray(scores)[0, 0]) * N, best,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_beam_eos_freezes_score_and_pads():
+    from bigdl_tpu.models.transformer import beam_search
+    m = _model(7)
+    prompt = np.random.default_rng(12).integers(1, VOCAB + 1, size=(1, 4))
+    # pick the greedy first token as eos so the top beam freezes at once
+    first = int(np.asarray(generate(m, prompt, GenerationConfig(1)))[0, 0])
+    beams, scores = beam_search(m, prompt, num_beams=2, max_new_tokens=6,
+                                eos_id=first)
+    beams = np.asarray(beams)
+    frozen = beams[0][beams[0, :, 0] == first]
+    assert frozen.shape[0] >= 1
+    # after the eos, every position is padding 0
+    np.testing.assert_array_equal(frozen[0, 1:], 0)
+
+
+def test_beam_length_penalty_uses_actual_lengths():
+    """An eos-frozen beam is normalized by ITS length, not
+    max_new_tokens (review r2) — so scores differ across length_penalty
+    values when lengths differ."""
+    from bigdl_tpu.models.transformer import beam_search
+    m = _model(7)
+    prompt = np.random.default_rng(12).integers(1, VOCAB + 1, size=(1, 4))
+    first = int(np.asarray(generate(m, prompt, GenerationConfig(1)))[0, 0])
+    _, s0 = beam_search(m, prompt, num_beams=2, max_new_tokens=6,
+                        eos_id=first, length_penalty=0.0)
+    _, s1 = beam_search(m, prompt, num_beams=2, max_new_tokens=6,
+                        eos_id=first, length_penalty=1.0)
+    s0, s1 = np.asarray(s0), np.asarray(s1)
+    # lp=0 leaves raw totals; lp=1 divides by per-beam lengths, which
+    # differ between the frozen (len 1) and unfrozen (len 6) beams
+    ratios = s0 / s1
+    assert not np.allclose(ratios[0, 0], ratios[0, 1]), (s0, s1)
