@@ -219,3 +219,21 @@ def test_beam_length_penalty_uses_actual_lengths():
     # differ between the frozen (len 1) and unfrozen (len 6) beams
     ratios = s0 / s1
     assert not np.allclose(ratios[0, 0], ratios[0, 1]), (s0, s1)
+
+
+def test_beam_eos_hypothesis_survives_pruning():
+    """Review r3: pruning happens in normalized space, so an eos-frozen
+    short hypothesis with the best per-token score must survive the
+    search and rank first under length_penalty=1."""
+    from bigdl_tpu.models.transformer import beam_search
+    m = _model(7)
+    prompt = np.random.default_rng(12).integers(1, VOCAB + 1, size=(1, 4))
+    first = int(np.asarray(generate(m, prompt, GenerationConfig(1)))[0, 0])
+    beams, scores = beam_search(m, prompt, num_beams=2, max_new_tokens=6,
+                                eos_id=first, length_penalty=1.0)
+    beams, scores = np.asarray(beams), np.asarray(scores)
+    # the greedy first token IS the model's best single step; frozen at
+    # length 1, its per-token score beats any 6-token average
+    assert beams[0, 0, 0] == first
+    np.testing.assert_array_equal(beams[0, 0, 1:], 0)
+    assert scores[0, 0] >= scores[0, 1]
