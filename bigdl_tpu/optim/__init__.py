@@ -1,7 +1,8 @@
 """Training loops, optim methods, triggers, validation (reference:
 dl/.../bigdl/optim/)."""
 
-from bigdl_tpu.optim.optim_method import OptimMethod, Adagrad, LBFGS
+from bigdl_tpu.optim.optim_method import (OptimMethod, Adagrad, Adam,
+                                          AdamW, LBFGS)
 from bigdl_tpu.optim.sgd import (SGD, Default, Step, EpochStep, EpochDecay,
                                  Poly, Regime, EpochSchedule)
 from bigdl_tpu.optim.trigger import (Trigger, every_epoch, several_iteration,

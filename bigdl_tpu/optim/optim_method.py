@@ -14,7 +14,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["OptimMethod", "Adagrad", "LBFGS"]
+__all__ = ["OptimMethod", "Adagrad", "Adam", "AdamW", "LBFGS"]
+
+
+def _tree_unzip(tree, n):
+    """Split a tree.map result whose leaves are n-tuples into n trees.
+    Assumes no structural tuple nodes in params pytrees (all dict-keyed
+    here) — the one place that assumption lives."""
+    leaf = lambda x: isinstance(x, tuple)
+    return tuple(jax.tree.map(lambda x: x[i], tree, is_leaf=leaf)
+                 for i in range(n))
 
 
 class OptimMethod:
@@ -70,12 +79,65 @@ class Adagrad(OptimMethod):
             return p_new, a_new
 
         pairs = jax.tree.map(upd, grads, params, state["accum"])
-        new_params = jax.tree.map(lambda t: t[0], pairs,
-                                  is_leaf=lambda t: isinstance(t, tuple))
-        accum = jax.tree.map(lambda t: t[1], pairs,
-                             is_leaf=lambda t: isinstance(t, tuple))
+        new_params, accum = _tree_unzip(pairs, 2)
         return new_params, dict(state, accum=accum,
                                 neval=state["neval"] + 1)
+
+
+class Adam(OptimMethod):
+    """Adam (Kingma & Ba). Beyond the reference's 2016 menu (SGD /
+    Adagrad / LBFGS) — carried as the TPU-era default a reference user
+    switching to transformer-scale training expects. Torch-convention
+    update (bias-corrected moments; ``weight_decay`` adds L2 to the
+    gradient like torch.optim.Adam)."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+
+    decoupled = False   # AdamW flips this
+
+    def init_state(self, params):
+        return {"neval": jnp.zeros((), jnp.int32),
+                "epoch": jnp.ones((), jnp.int32),
+                "m": jax.tree.map(jnp.zeros_like, params),
+                "v": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        t = state["neval"] + 1
+        b1, b2 = self.beta1, self.beta2
+        c1 = 1.0 - b1 ** t.astype(jnp.float32)
+        c2 = 1.0 - b2 ** t.astype(jnp.float32)
+        lr = self.learning_rate
+
+        def upd(g, p, m, v):
+            if self.weight_decay > 0 and not self.decoupled:
+                g = g + self.weight_decay * p
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            step = lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
+            if self.weight_decay > 0 and self.decoupled:
+                step = step + lr * self.weight_decay * p
+            return p - step, m_new, v_new
+
+        triples = jax.tree.map(upd, grads, params, state["m"], state["v"])
+        new_params, m, v = _tree_unzip(triples, 3)
+        return new_params, dict(state, m=m, v=v, neval=t)
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (Loshchilov & Hutter), matching
+    torch.optim.AdamW's update."""
+
+    decoupled = True
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 1e-2):
+        super().__init__(learning_rate, beta1, beta2, eps, weight_decay)
 
 
 class LBFGS(OptimMethod):

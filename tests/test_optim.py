@@ -242,3 +242,66 @@ class TestCheckpointIO:
         y2 = m2.forward(x)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                    rtol=1e-6)
+
+
+class TestAdam:
+    def test_matches_torch_adam(self):
+        import torch
+        rng = np.random.default_rng(20)
+        w0 = rng.standard_normal((6, 4)).astype(np.float32)
+        from bigdl_tpu.optim import Adam
+        opt = Adam(learning_rate=0.01, weight_decay=0.01)
+        params = {"w": jnp.asarray(w0)}
+        state = opt.init_state(params)
+        wt = torch.tensor(w0, requires_grad=True)
+        topt = torch.optim.Adam([wt], lr=0.01, weight_decay=0.01)
+        for i in range(5):
+            g = rng.standard_normal((6, 4)).astype(np.float32)
+            params, state = opt.update({"w": jnp.asarray(g)}, params,
+                                       state)
+            wt.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   wt.detach().numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_matches_torch_adamw(self):
+        import torch
+        rng = np.random.default_rng(21)
+        w0 = rng.standard_normal((5, 3)).astype(np.float32)
+        from bigdl_tpu.optim import AdamW
+        opt = AdamW(learning_rate=0.02, weight_decay=0.1)
+        params = {"w": jnp.asarray(w0)}
+        state = opt.init_state(params)
+        wt = torch.tensor(w0, requires_grad=True)
+        topt = torch.optim.AdamW([wt], lr=0.02, weight_decay=0.1)
+        for i in range(5):
+            g = rng.standard_normal((5, 3)).astype(np.float32)
+            params, state = opt.update({"w": jnp.asarray(g)}, params,
+                                       state)
+            wt.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   wt.detach().numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_trains_through_optimizer_facade(self):
+        from bigdl_tpu.optim import Adam, Optimizer, max_iteration
+        from bigdl_tpu.dataset import dataset as ds
+        from bigdl_tpu.dataset.sample import MiniBatch
+        rng = np.random.default_rng(22)
+        data = rng.standard_normal((32, 10)).astype(np.float32)
+        labels = rng.integers(1, 5, size=(32,))
+        dset = ds.iterator_source(
+            lambda: iter([MiniBatch(data, labels)]), size=32)
+        model = (nn.Sequential().add(nn.Linear(10, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+        crit = nn.ClassNLLCriterion()
+        opt = Optimizer(model, dset, crit)
+        opt.set_optim_method(Adam(learning_rate=0.01))
+        opt.set_end_when(max_iteration(40))
+        trained = opt.optimize()
+        y, _ = trained.apply(trained.params, trained.state,
+                             jnp.asarray(data))
+        final = float(crit.apply(y, jnp.asarray(labels)))
+        assert final < 1.0, final
