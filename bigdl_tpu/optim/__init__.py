@@ -4,7 +4,8 @@ dl/.../bigdl/optim/)."""
 from bigdl_tpu.optim.optim_method import (OptimMethod, Adagrad, Adam,
                                           AdamW, LBFGS)
 from bigdl_tpu.optim.sgd import (SGD, Default, Step, EpochStep, EpochDecay,
-                                 Poly, Regime, EpochSchedule)
+                                 Poly, Regime, EpochSchedule, Warmup,
+                                 CosineAnnealing)
 from bigdl_tpu.optim.trigger import (Trigger, every_epoch, several_iteration,
                                      max_epoch, max_iteration, min_loss,
                                      or_trigger, and_trigger)

@@ -93,10 +93,12 @@ class Adam(OptimMethod):
 
     def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule=None):
         self.learning_rate = learning_rate
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
         self.weight_decay = weight_decay
+        self.schedule = learning_rate_schedule
 
     decoupled = False   # AdamW flips this
 
@@ -112,6 +114,8 @@ class Adam(OptimMethod):
         c1 = 1.0 - b1 ** t.astype(jnp.float32)
         c2 = 1.0 - b2 ** t.astype(jnp.float32)
         lr = self.learning_rate
+        if self.schedule is not None:
+            lr = self.schedule(lr, state["neval"], state["epoch"])
 
         def upd(g, p, m, v):
             if self.weight_decay > 0 and not self.decoupled:
@@ -136,8 +140,10 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
-                 weight_decay: float = 1e-2):
-        super().__init__(learning_rate, beta1, beta2, eps, weight_decay)
+                 weight_decay: float = 1e-2,
+                 learning_rate_schedule=None):
+        super().__init__(learning_rate, beta1, beta2, eps, weight_decay,
+                         learning_rate_schedule)
 
 
 class LBFGS(OptimMethod):

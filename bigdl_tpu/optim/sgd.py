@@ -16,10 +16,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.optim_method import OptimMethod, _tree_unzip
 
 __all__ = ["SGD", "Default", "Step", "EpochStep", "EpochDecay", "Poly",
-           "Regime", "EpochSchedule"]
+           "Regime", "EpochSchedule", "Warmup", "CosineAnnealing"]
 
 
 # ---------------------------------------------------------------------------
@@ -83,6 +83,33 @@ class Poly(LearningRateSchedule):
     def __call__(self, lr, neval, epoch):
         frac = jnp.minimum(neval / self.max_iteration, 1.0)
         return lr * jnp.power(1.0 - frac, self.power)
+
+
+@dataclass
+class Warmup(LearningRateSchedule):
+    """Linear warmup over ``warmup_iterations`` then hand off to
+    ``after`` (transformer-era extension; the reference's schedules are
+    all decay-only)."""
+    warmup_iterations: int
+    after: LearningRateSchedule = field(default_factory=Default)
+
+    def __call__(self, lr, neval, epoch):
+        frac = jnp.minimum((neval + 1) / self.warmup_iterations, 1.0)
+        post = self.after(lr, neval - self.warmup_iterations, epoch)
+        return jnp.where(neval < self.warmup_iterations, lr * frac, post)
+
+
+@dataclass
+class CosineAnnealing(LearningRateSchedule):
+    """clr = min_lr + (lr - min_lr) * (1 + cos(pi * t/T)) / 2
+    (SGDR-style single cycle; transformer-era extension)."""
+    max_iteration: int
+    min_lr: float = 0.0
+
+    def __call__(self, lr, neval, epoch):
+        frac = jnp.minimum(jnp.maximum(neval, 0) / self.max_iteration, 1.0)
+        return self.min_lr + (lr - self.min_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
 
 
 @dataclass
@@ -150,8 +177,17 @@ class SGD(OptimMethod):
     def current_lr(self, state):
         lr = self.schedule(self.learning_rate, state["neval"],
                            state["epoch"])
-        if isinstance(self.schedule, Default):
-            lr = lr / (1.0 + state["neval"] * self.learning_rate_decay)
+        # Default's decay is applied here (it needs SGD's
+        # learning_rate_decay knob) — including when Default is the
+        # post-warmup schedule inside Warmup
+        inner = (self.schedule.after
+                 if isinstance(self.schedule, Warmup) else self.schedule)
+        if isinstance(inner, Default):
+            neval = state["neval"]
+            if isinstance(self.schedule, Warmup):
+                neval = jnp.maximum(
+                    neval - self.schedule.warmup_iterations, 0)
+            lr = lr / (1.0 + neval * self.learning_rate_decay)
         return lr
 
     def update(self, grads, params, state):
@@ -179,10 +215,7 @@ class SGD(OptimMethod):
 
         if mom > 0:
             flat = jax.tree.map(upd, grads, params, state["velocity"])
-            new_params = jax.tree.map(lambda t: t[0], flat,
-                                      is_leaf=lambda t: isinstance(t, tuple))
-            velocity = jax.tree.map(lambda t: t[1], flat,
-                                    is_leaf=lambda t: isinstance(t, tuple))
+            new_params, velocity = _tree_unzip(flat, 2)
             new_state = dict(state, velocity=velocity,
                              neval=state["neval"] + 1)
         else:

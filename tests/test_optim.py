@@ -305,3 +305,51 @@ class TestAdam:
                              jnp.asarray(data))
         final = float(crit.apply(y, jnp.asarray(labels)))
         assert final < 1.0, final
+
+
+class TestWarmupCosine:
+    def test_warmup_then_cosine_shape(self):
+        from bigdl_tpu.optim import CosineAnnealing, Warmup
+        import jax.numpy as jnp
+        sched = Warmup(10, CosineAnnealing(90, min_lr=0.1))
+        lr = 1.0
+        vals = [float(sched(lr, jnp.asarray(n), jnp.asarray(1)))
+                for n in range(110)]
+        # linear ramp to lr over the first 10 iterations
+        np.testing.assert_allclose(vals[:10],
+                                   [(n + 1) / 10 for n in range(10)],
+                                   rtol=1e-6)
+        assert abs(vals[10] - 1.0) < 0.01          # cosine starts at lr
+        assert abs(vals[100] - 0.1) < 1e-6         # floors at min_lr
+        assert all(a >= b - 1e-9 for a, b in zip(vals[10:], vals[11:]))
+
+    def test_adam_with_schedule_trains(self):
+        from bigdl_tpu.optim import Adam, CosineAnnealing, Warmup
+        rng = np.random.default_rng(30)
+        w = {"w": jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))}
+        target = jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))
+        opt = Adam(learning_rate=0.2,
+                   learning_rate_schedule=Warmup(5, CosineAnnealing(50)))
+        state = opt.init_state(w)
+        def loss(p): return jnp.mean((p["w"] - target) ** 2)
+        l0 = float(loss(w))
+        for _ in range(60):
+            g = jax.grad(loss)(w)
+            w, state = opt.update(g, w, state)
+        assert float(loss(w)) < l0 * 0.1
+
+
+def test_sgd_default_decay_applies_after_warmup():
+    """Review r2: Warmup(Default()) must keep SGD's 1/(1+n*decay)
+    behavior after the ramp (counted from the end of warmup)."""
+    from bigdl_tpu.optim import SGD, Warmup
+    sgd = SGD(learning_rate=1.0, learning_rate_decay=0.5,
+              learning_rate_schedule=Warmup(4))
+    state = sgd.init_state({"w": jnp.zeros((1,))})
+    lrs = []
+    for n in range(8):
+        st = dict(state, neval=jnp.asarray(n))
+        lrs.append(float(sgd.current_lr(st)))
+    np.testing.assert_allclose(lrs[:4], [0.25, 0.5, 0.75, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(lrs[4:], [1/(1+0.5*k) for k in range(4)],
+                               rtol=1e-6)
