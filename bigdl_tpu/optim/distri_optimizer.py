@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.optimizer import Optimizer, _clip_gradients
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -124,6 +124,7 @@ class DistriOptimizer(Optimizer):
 
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = _clip_gradients(grads, self.grad_clip)
             opt_state = dict(opt_state, epoch=epoch)
             new_params, new_opt_state = optim.update(grads, params,
                                                      opt_state)

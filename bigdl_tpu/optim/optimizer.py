@@ -32,6 +32,22 @@ logger = logging.getLogger("bigdl_tpu.optim")
 __all__ = ["Optimizer", "LocalOptimizer"]
 
 
+def _clip_gradients(grads, clip):
+    """Global-L2 and/or constant clipping, traced into the train step."""
+    if not clip:
+        return grads
+    if clip["min_value"] is not None:
+        grads = jax.tree.map(
+            lambda g: jnp.clip(g, clip["min_value"], clip["max_value"]),
+            grads)
+    if clip["l2_norm"] is not None:
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                            for g in jax.tree.leaves(grads)))
+        scale = jnp.minimum(1.0, clip["l2_norm"] / (norm + 1e-12))
+        grads = jax.tree.map(lambda g: g * scale, grads)
+    return grads
+
+
 class Optimizer:
     """Facade + factory (reference optim/Optimizer.scala)."""
 
@@ -70,6 +86,7 @@ class Optimizer:
         self.profile_start = 0
         self.profile_iters = 0
         self._profiling = False
+        self.grad_clip = None
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -89,6 +106,28 @@ class Optimizer:
 
     def set_state(self, state):
         self.state = Table(state)
+        return self
+
+    def set_gradient_clipping(self, *, l2_norm: float | None = None,
+                              min_value: float | None = None,
+                              max_value: float | None = None):
+        """Clip gradients inside the jitted train step: by global L2 norm
+        (transformer-era staple) and/or constant min/max (the clipping
+        style later BigDL releases expose). Applies to Local and Distri
+        optimizers alike; returns self."""
+        if l2_norm is None and min_value is None and max_value is None:
+            raise ValueError(
+                "set_gradient_clipping needs l2_norm and/or "
+                "min_value+max_value")
+        if l2_norm is not None and l2_norm <= 0:
+            raise ValueError(f"l2_norm must be > 0, got {l2_norm}")
+        if ((min_value is None) != (max_value is None)):
+            raise ValueError("min_value and max_value must be set together")
+        if min_value is not None and min_value >= max_value:
+            raise ValueError(f"min_value {min_value} must be < "
+                             f"max_value {max_value}")
+        self.grad_clip = {"l2_norm": l2_norm, "min_value": min_value,
+                          "max_value": max_value}
         return self
 
     def set_optim_method(self, method: OptimMethod):
@@ -291,6 +330,7 @@ class LocalOptimizer(Optimizer):
 
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = _clip_gradients(grads, self.grad_clip)
             opt_state = dict(opt_state, epoch=epoch)
             new_params, new_opt_state = optim.update(grads, params,
                                                      opt_state)

@@ -32,6 +32,13 @@ class LearningRateSchedule:
     def __call__(self, lr, neval, epoch):
         raise NotImplementedError
 
+    def effective(self) -> "LearningRateSchedule":
+        """The schedule whose TYPE governs SGD's special cases (Default
+        decay, EpochSchedule weight-decay regimes). Wrappers (Warmup)
+        override to return their inner schedule, so nesting never
+        silently disables the introspection."""
+        return self
+
 
 @dataclass
 class Default(LearningRateSchedule):
@@ -97,6 +104,9 @@ class Warmup(LearningRateSchedule):
         frac = jnp.minimum((neval + 1) / self.warmup_iterations, 1.0)
         post = self.after(lr, neval - self.warmup_iterations, epoch)
         return jnp.where(neval < self.warmup_iterations, lr * frac, post)
+
+    def effective(self):
+        return self.after.effective()
 
 
 @dataclass
@@ -179,9 +189,8 @@ class SGD(OptimMethod):
                            state["epoch"])
         # Default's decay is applied here (it needs SGD's
         # learning_rate_decay knob) — including when Default is the
-        # post-warmup schedule inside Warmup
-        inner = (self.schedule.after
-                 if isinstance(self.schedule, Warmup) else self.schedule)
+        # post-warmup schedule inside (possibly nested) Warmup
+        inner = self.schedule.effective()
         if isinstance(inner, Default):
             neval = state["neval"]
             if isinstance(self.schedule, Warmup):
@@ -193,8 +202,9 @@ class SGD(OptimMethod):
     def update(self, grads, params, state):
         clr = self.current_lr(state)
         wd = self.weight_decay
-        if isinstance(self.schedule, EpochSchedule):
-            wd = self.schedule.weight_decay(wd, state["epoch"])
+        eff = self.schedule.effective()
+        if isinstance(eff, EpochSchedule):
+            wd = eff.weight_decay(wd, state["epoch"])
         mom, damp = self.momentum, self.dampening
 
         def upd(g, p, v):

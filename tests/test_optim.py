@@ -353,3 +353,109 @@ def test_sgd_default_decay_applies_after_warmup():
     np.testing.assert_allclose(lrs[:4], [0.25, 0.5, 0.75, 1.0], rtol=1e-6)
     np.testing.assert_allclose(lrs[4:], [1/(1+0.5*k) for k in range(4)],
                                rtol=1e-6)
+
+
+class TestGradientClipping:
+    def _setup(self):
+        from bigdl_tpu.dataset import dataset as ds
+        from bigdl_tpu.dataset.sample import MiniBatch
+        rng = np.random.default_rng(40)
+        data = (100.0 * rng.standard_normal((16, 8))).astype(np.float32)
+        labels = rng.integers(1, 4, size=(16,))
+        dset = ds.iterator_source(
+            lambda: iter([MiniBatch(data, labels)]), size=16)
+        model = (nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        return model, dset
+
+    def test_l2_clipping_bounds_update(self):
+        from bigdl_tpu.optim import Optimizer, SGD, max_iteration
+        model, dset = self._setup()
+        opt = Optimizer(model, dset, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=1.0))
+        opt.set_gradient_clipping(l2_norm=0.1)
+        opt.set_end_when(max_iteration(1))
+        before = jax.tree.map(np.asarray, model.params)
+        trained = opt.optimize()
+        # with ||g|| clipped to 0.1 and lr 1.0, the global update norm
+        # is <= 0.1 despite the huge-input gradients
+        delta = np.sqrt(sum(
+            np.sum((np.asarray(a) - b) ** 2) for a, b in zip(
+                jax.tree.leaves(trained.params),
+                jax.tree.leaves(before))))
+        assert delta <= 0.1 + 1e-5, delta
+
+    def test_constant_clipping_bounds_each_component(self):
+        from bigdl_tpu.optim import Optimizer, SGD, max_iteration
+        model, dset = self._setup()
+        opt = Optimizer(model, dset, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=1.0))
+        opt.set_gradient_clipping(min_value=-0.01, max_value=0.01)
+        opt.set_end_when(max_iteration(1))
+        before = jax.tree.map(np.asarray, model.params)
+        trained = opt.optimize()
+        for a, b in zip(jax.tree.leaves(trained.params),
+                        jax.tree.leaves(before)):
+            assert np.max(np.abs(np.asarray(a) - b)) <= 0.01 + 1e-6
+
+    def test_validation_of_arguments(self):
+        from bigdl_tpu.optim import Optimizer
+        model, dset = self._setup()
+        opt = Optimizer(model, dset, nn.ClassNLLCriterion())
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="l2_norm"):
+            opt.set_gradient_clipping(l2_norm=0.0)
+        with _pytest.raises(ValueError, match="together"):
+            opt.set_gradient_clipping(min_value=-1.0)
+
+    def test_distri_step_clips_too(self):
+        from bigdl_tpu.optim import SGD, max_iteration
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        from bigdl_tpu.parallel.engine import Engine
+        model, dset = self._setup()
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 8})
+        opt = DistriOptimizer(model, dset, nn.ClassNLLCriterion(),
+                              mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=1.0))
+        opt.set_gradient_clipping(l2_norm=0.05)
+        opt.set_end_when(max_iteration(1))
+        before = jax.tree.map(np.asarray, model.params)
+        trained = opt.optimize()
+        Engine.reset()
+        delta = np.sqrt(sum(
+            np.sum((np.asarray(a) - b) ** 2) for a, b in zip(
+                jax.tree.leaves(trained.params),
+                jax.tree.leaves(before))))
+        assert delta <= 0.05 + 1e-5, delta
+
+
+def test_epoch_schedule_weight_decay_survives_warmup_wrapper():
+    """Review r3: Warmup(EpochSchedule) must still apply the regimes'
+    weightDecay overrides (effective() unwrapping)."""
+    from bigdl_tpu.optim import EpochSchedule, Regime, SGD, Warmup
+    sched = EpochSchedule([Regime(1, 10, {"learningRate": 0.5,
+                                          "weightDecay": 0.25})])
+    sgd = SGD(learning_rate=1.0, weight_decay=0.0,
+              learning_rate_schedule=Warmup(2, sched))
+    params = {"w": jnp.ones((2,))}
+    state = sgd.init_state(params)
+    state = dict(state, neval=jnp.asarray(5), epoch=jnp.asarray(3))
+    grads = {"w": jnp.zeros((2,))}
+    new_params, _ = sgd.update(grads, params, state)
+    # zero grads: the only update is lr * wd * w = 0.5 * 0.25 * 1
+    np.testing.assert_allclose(np.asarray(new_params["w"]),
+                               1.0 - 0.125, rtol=1e-6)
+
+
+def test_clipping_rejects_bad_args():
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.dataset import dataset as ds
+    from bigdl_tpu.dataset.sample import MiniBatch
+    dset = ds.iterator_source(lambda: iter([]), size=0)
+    model = nn.Sequential().add(nn.Linear(2, 2))
+    opt = Optimizer(model, dset, nn.MSECriterion())
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="needs"):
+        opt.set_gradient_clipping()
+    with _pytest.raises(ValueError, match="must be <"):
+        opt.set_gradient_clipping(min_value=0.1, max_value=-0.1)
