@@ -113,10 +113,15 @@ class CrossEntropyCriterion(Criterion):
     the difference between several extra (B, S, V) buffers and none
     (docs/PERF.md transformer section)."""
 
-    def __init__(self, weights=None, size_average: bool = True):
+    def __init__(self, weights=None, size_average: bool = True,
+                 label_smoothing: float = 0.0):
         super().__init__()
         self.weights = None if weights is None else jnp.asarray(weights)
         self.size_average = size_average
+        if not 0.0 <= label_smoothing < 1.0:
+            raise ValueError(f"label_smoothing must be in [0, 1), got "
+                             f"{label_smoothing}")
+        self.label_smoothing = label_smoothing
 
     def apply(self, x, target):
         t = target.astype(jnp.int32).reshape(-1) - 1
@@ -124,8 +129,22 @@ class CrossEntropyCriterion(Criterion):
             jnp.promote_types(x.dtype, jnp.float32))
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
-        return _nll_reduce(lse - picked, t, self.weights,
-                           self.size_average)
+        per = lse - picked
+        eps = self.label_smoothing
+        if eps > 0.0 and self.weights is not None:
+            # torch convention with class weights: the target term is
+            # weighted by w[t] but the smoothing term by each class's
+            # own weight (-(logp * w).sum / K); mean divides by sum w[t]
+            w = self.weights.astype(logits.dtype)
+            w_t = jnp.take(w, t)
+            smooth = (lse * jnp.sum(w) - logits @ w) / logits.shape[-1]
+            total = jnp.sum((1.0 - eps) * w_t * per + eps * smooth)
+            return total / jnp.sum(w_t) if self.size_average else total
+        if eps > 0.0:
+            # (1-eps)*CE(target) + eps*mean_c CE(c)
+            per = (1.0 - eps) * per + eps * (lse - jnp.mean(logits,
+                                                            axis=-1))
+        return _nll_reduce(per, t, self.weights, self.size_average)
 
 
 class ClassSimplexCriterion(Criterion):

@@ -260,3 +260,23 @@ def test_weighted_cross_entropy_matches_torch():
         want = F.cross_entropy(torch.tensor(x), torch.tensor(t - 1),
                                weight=torch.tensor(w), reduction=red)
         np.testing.assert_allclose(got, float(want), rtol=1e-5)
+
+
+def test_label_smoothing_matches_torch():
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((10, 7)).astype(np.float32)
+    t = rng.integers(1, 8, size=(10,))
+    w = rng.uniform(0.5, 2.0, size=(7,)).astype(np.float32)
+    for eps in (0.1, 0.3):
+        for weights in (None, w):
+            c = nn.CrossEntropyCriterion(weights=weights,
+                                         label_smoothing=eps)
+            got = float(c.apply(jnp.asarray(x), jnp.asarray(t)))
+            want = F.cross_entropy(
+                torch.tensor(x), torch.tensor(t - 1),
+                weight=None if weights is None else torch.tensor(w),
+                label_smoothing=eps)
+            np.testing.assert_allclose(got, float(want), rtol=1e-5)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="label_smoothing"):
+        nn.CrossEntropyCriterion(label_smoothing=1.0)
