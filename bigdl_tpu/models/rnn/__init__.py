@@ -1,2 +1,3 @@
 """rnn model family (reference models/rnn/)."""
 from bigdl_tpu.models.rnn.model import *  # noqa: F401,F403
+from bigdl_tpu.models.rnn.generate import generate  # noqa: F401,E402

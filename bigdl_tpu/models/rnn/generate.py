@@ -1,0 +1,102 @@
+"""Autoregressive sampling for recurrent char/word LMs.
+
+The reference's rnn example trains ``SimpleRNN`` on tokenized text
+(models/rnn/Train.scala); this completes the family with the decode
+loop, mirroring models/transformer/generate.py: hidden state is the
+"cache", the decode step is one cell application, and the whole loop is
+a single ``lax.scan`` — works for any ``Cell`` (RnnCell/LSTM/GRU)
+inside the ``BatchedSimpleRNN`` shape
+``Sequential(Recurrent(cell), TimeDistributed(Linear), LogSoftMax)``.
+
+Inputs are 1-based token ids; the model consumes one-hot rows of width
+``cell.input_size`` (the reference's LabeledSentence one-hot encoding).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate"]
+
+
+def _parts(model, params):
+    from bigdl_tpu.nn import Recurrent, TimeDistributed
+    if not (len(model) >= 2 and isinstance(model[0], Recurrent)
+            and isinstance(model[1], TimeDistributed)):
+        raise ValueError(
+            "generate expects Sequential(Recurrent(cell), "
+            "TimeDistributed(Linear), ...) — the BatchedSimpleRNN shape")
+    cell = model[0].cell
+    return cell, params["0"]["0"], params["1"]["0"]
+
+
+def generate(model, prompt, max_new_tokens: int = 32, *,
+             temperature: float = 0.0, top_k: int | None = None,
+             rng=None, params=None):
+    """Decode ``max_new_tokens`` 1-based token ids after ``prompt``
+    (B, P). temperature 0 = greedy; ``top_k`` truncates the softmax
+    support when sampling."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    params = model.params if params is None else params
+    cell, cell_p, lin_p = _parts(model, params)
+    prompt = jnp.asarray(prompt)
+    b, p_len = prompt.shape
+    width = cell.input_size
+    vocab = lin_p["weight"].shape[0]
+
+    def onehot(tok):
+        return jax.nn.one_hot(tok.astype(jnp.int32) - 1, width,
+                              dtype=lin_p["weight"].dtype)
+
+    def project(out):
+        logits = out @ lin_p["weight"].T
+        if "bias" in lin_p:                 # Linear(with_bias=False)
+            logits = logits + lin_p["bias"]
+        return logits.astype(jnp.float32)
+
+    def cell_step(h, tok):
+        (out, h_new), _ = cell.apply(cell_p, {}, (onehot(tok), h))
+        return h_new, project(out)
+
+    # prefill: scan the prompt through the cell, projecting ONLY the
+    # final step's output (a (P, B, V) logits stack would be pure waste)
+    h0 = cell.init_hidden(b, lin_p["weight"].dtype)
+
+    def prefill(carry, tok):
+        h, _ = carry
+        (out, h_new), _ = cell.apply(cell_p, {}, (onehot(tok), h))
+        return (h_new, out), None
+
+    (h, last_out), _ = jax.lax.scan(prefill, (h0, jnp.zeros(
+        (b, cell.hidden_size), lin_p["weight"].dtype)), prompt.T)
+    logits = project(last_out)
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1) + 1
+        logits = logits / temperature
+        if top_k is not None:
+            k_eff = min(top_k, vocab)
+            kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
+            logits = jnp.where(logits < kth, -1e9, logits)
+        return jax.random.categorical(key, logits, axis=-1) + 1
+
+    rng, k0 = jax.random.split(rng)
+    first = sample(logits, k0)
+
+    def step(carry, key):
+        tok, h = carry
+        h_new, logits = cell_step(h, tok)
+        nxt = sample(logits, key)
+        return (nxt, h_new), nxt
+
+    keys = jax.random.split(rng, max(max_new_tokens - 1, 1))
+    _, rest = jax.lax.scan(step, (first, h), keys[:max_new_tokens - 1])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -35,6 +35,9 @@ class GenerationConfig:
                  top_k: int | None = None):
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_k = top_k

@@ -45,10 +45,15 @@ class Cell(Module):
     def hid_shape(self, batch: int):
         raise NotImplementedError
 
-    def init_hidden(self, batch: int):
-        return jax.tree.map(lambda s: jnp.zeros(s, default_dtype()),
-                            self.hid_shape(batch),
-                            is_leaf=lambda x: isinstance(x, tuple))
+    def init_hidden(self, batch: int, dtype=None):
+        """Zero hidden state matching ``hid_shape`` (handles nested
+        tuples like LSTM's ((B,H),(B,H)): a leaf is a tuple of ints,
+        not any tuple)."""
+        return jax.tree.map(
+            lambda s: jnp.zeros(s, dtype or default_dtype()),
+            self.hid_shape(batch),
+            is_leaf=lambda v: isinstance(v, tuple) and all(
+                isinstance(e, int) for e in v))
 
 
 class RnnCell(Cell):
@@ -178,10 +183,7 @@ class Recurrent(Container):
         if isinstance(x, (tuple, list)):
             x, lengths = x
         cell = self.cell
-        h0 = jax.tree.map(
-            lambda s: jnp.zeros(s, x.dtype), cell.hid_shape(x.shape[0]),
-            is_leaf=lambda v: isinstance(v, tuple) and all(
-                isinstance(e, int) for e in v))
+        h0 = cell.init_hidden(x.shape[0], x.dtype)
         xs = jnp.swapaxes(x, 0, 1)  # (T, N, I) for scan
         p0, s0 = params["0"], state["0"]
 

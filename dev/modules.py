@@ -21,7 +21,8 @@ MODULES = {
                  "tests/test_sequence_parallel.py",
                  "tests/test_flash_attention.py"],
     "models": ["tests/test_models.py", "tests/test_transformer.py",
-               "tests/test_generate.py", "tests/test_perf_paths.py"],
+               "tests/test_generate.py", "tests/test_rnn_generate.py",
+               "tests/test_perf_paths.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],
     "examples": ["tests/test_examples.py",
                  "tests/test_textclassification.py"],
