@@ -122,7 +122,11 @@ def main():
         "metric": "inception_v1_train_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
+        # The reference publishes no quantitative number; 150 img/s is a
+        # documented K80-class stand-in (see module docstring). MFU and
+        # achieved_tflops are the honest readout.
         "vs_baseline": round(value / BASELINE_IMG_PER_SEC, 3),
+        "baseline_is_standin": True,
         "achieved_tflops": round(achieved_tflops, 1),
     }
     if peak:

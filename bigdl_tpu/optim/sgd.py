@@ -193,9 +193,14 @@ class SGD(OptimMethod):
         inner = self.schedule.effective()
         if isinstance(inner, Default):
             neval = state["neval"]
-            if isinstance(self.schedule, Warmup):
-                neval = jnp.maximum(
-                    neval - self.schedule.warmup_iterations, 0)
+            # subtract warmup iterations across EVERY Warmup layer so
+            # nested Warmup(Warmup(Default)) decays from the true
+            # post-warmup iteration count
+            sched = self.schedule
+            while isinstance(sched, Warmup):
+                neval = neval - sched.warmup_iterations
+                sched = sched.after
+            neval = jnp.maximum(neval, 0)
             lr = lr / (1.0 + neval * self.learning_rate_decay)
         return lr
 

@@ -1,17 +1,25 @@
 """Checkpoint / snapshot IO.
 
 Reference parity: utils/File.scala:26-130 — Java-serialization save/load with
-HDFS support, the backend of ``Optimizer.setCheckpoint`` and
+HDFS support (``File.scala:62-113`` routes any non-local URI through the
+Hadoop FileSystem API), the backend of ``Optimizer.setCheckpoint`` and
 ``Module.save``. Here: arrays are stored in an ``.npz`` member and object
 structure in a pickle member inside one zip file — portable, versioned, and
-free of Java-serialization's fragility. GCS/remote paths are accepted via
-fsspec-style prefixes when available; local FS always works.
+free of Java-serialization's fragility. Paths with a URL scheme
+(``file://``, ``gs://``, ``hdfs://``, ``s3://``, ``memory://`` …) are
+routed through fsspec — the Python ecosystem's Hadoop-FileSystem
+equivalent; plain paths use the local FS directly and never import
+fsspec. Crash safety: local paths stream to a sibling ``.tmp`` then
+rename; URL paths write the target object directly, since a
+single-object PUT is already atomic on object stores (a rename there
+would be copy+delete — weaker, not stronger).
 """
 from __future__ import annotations
 
-import io
+import contextlib
 import os
 import pickle
+import re
 import zipfile
 
 import jax
@@ -20,6 +28,75 @@ import numpy as np
 __all__ = ["save", "load", "save_module", "load_module"]
 
 _MAGIC = "bigdl_tpu.v1"
+
+_SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+
+def _is_url(path) -> bool:
+    return isinstance(path, str) and bool(_SCHEME_RE.match(path))
+
+
+def _fs_for(path: str):
+    try:
+        import fsspec
+    except ImportError as e:  # covered only when fsspec is absent
+        raise ImportError(
+            f"checkpoint path {path!r} has a URL scheme, which needs the "
+            "'fsspec' package (pip install fsspec; plus the protocol's "
+            "driver, e.g. gcsfs for gs://)") from e
+    fs, _ = fsspec.core.url_to_fs(path)
+    return fs
+
+
+def _exists(path: str) -> bool:
+    if _is_url(path):
+        return _fs_for(path).exists(path)
+    return os.path.exists(path)
+
+
+@contextlib.contextmanager
+def _open_read(path: str):
+    if _is_url(path):
+        with _fs_for(path).open(path, "rb") as f:
+            yield f
+    else:
+        with open(path, "rb") as f:
+            yield f
+
+
+@contextlib.contextmanager
+def _open_write_atomic(path: str):
+    """Yield a writable binary stream that lands at ``path`` only on a
+    clean exit (reference File.scala:62-113 saveToHdfs semantics)."""
+    if _is_url(path):
+        fs = _fs_for(path)
+        dirname = path.rsplit("/", 1)[0]
+        if dirname and dirname != path:
+            fs.makedirs(dirname, exist_ok=True)
+        f = fs.open(path, "wb")
+        try:
+            yield f
+        except BaseException:
+            # don't let close() commit a truncated object over the last
+            # good checkpoint: staged-upload backends (gcsfs/s3fs —
+            # AbstractBufferedFile) abort the pending upload, leaving
+            # the previous object untouched; write-in-place backends
+            # (memory://) get the partial object deleted instead
+            import fsspec
+            if isinstance(f, fsspec.spec.AbstractBufferedFile):
+                f.discard()
+            else:
+                f.close()
+                with contextlib.suppress(Exception):
+                    fs.rm(path)
+            raise
+        f.close()
+        return
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "wb") as f:
+        yield f
+    os.replace(tmp, path)
 
 
 def _to_host(obj):
@@ -43,7 +120,7 @@ def _to_host(obj):
 def save(obj, path: str, overwrite: bool = False) -> None:
     """Serialize ``obj`` (modules, Tables, pytrees) to ``path``
     (reference File.save, utils/File.scala:62-90)."""
-    if os.path.exists(path) and not overwrite:
+    if _exists(path) and not overwrite:
         raise FileExistsError(
             f"{path} already exists (pass overwrite=True, reference "
             "File.save 'file exists' semantics)")
@@ -57,24 +134,22 @@ def save(obj, path: str, overwrite: bool = False) -> None:
             placeholders.append(("arr", f"a{i}"))
         else:
             placeholders.append(("obj", leaf))
-    buf = io.BytesIO()
-    np.savez(buf, **arrays)
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with zipfile.ZipFile(tmp, "w") as z:
+    with _open_write_atomic(path) as f, zipfile.ZipFile(f, "w") as z:
         z.writestr("magic", _MAGIC)
-        z.writestr("arrays.npz", buf.getvalue())
+        with z.open("arrays.npz", "w", force_zip64=True) as member:
+            np.savez(member, **arrays)
         z.writestr("structure.pkl",
                    pickle.dumps((treedef, placeholders),
                                 protocol=pickle.HIGHEST_PROTOCOL))
-    os.replace(tmp, path)
 
 
 def load(path: str):
     """Inverse of :func:`save` (reference File.load)."""
-    with zipfile.ZipFile(path) as z:
+    with _open_read(path) as f, zipfile.ZipFile(f) as z:
         assert z.read("magic").decode() == _MAGIC, "not a bigdl_tpu file"
-        npz = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
+        with z.open("arrays.npz") as member:
+            npz = np.load(member, allow_pickle=False)
+            npz = {k: npz[k] for k in npz.files}
         treedef, placeholders = pickle.loads(z.read("structure.pkl"))
     leaves = [npz[key] if kind == "arr" else key
               for kind, key in placeholders]
@@ -105,7 +180,7 @@ def save_module(module, path: str, overwrite: bool = False) -> None:
     arrays moved to host memory, so ``load_module`` restores a working
     module without re-materialization.
     """
-    if os.path.exists(path) and not overwrite:
+    if _exists(path) and not overwrite:
         raise FileExistsError(f"{path} already exists")
     module = module.clone_module()
     _strip_runtime(module)
@@ -115,16 +190,14 @@ def save_module(module, path: str, overwrite: bool = False) -> None:
         # rebind children onto subtrees of the host copies — without this
         # the pickle stores a second (device-array) copy per child
         module.sync(module.params, module.state)
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(tmp, "wb") as f:
-        pickle.dump((_MAGIC, module), f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    with _open_write_atomic(path) as f:
+        pickle.dump((_MAGIC, module), f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
 
 
 def load_module(path: str):
     """(reference Module.load, nn/Module.scala:27-29)"""
-    with open(path, "rb") as f:
+    with _open_read(path) as f:
         magic, module = pickle.load(f)
     assert magic == _MAGIC, "not a bigdl_tpu module file"
     if module.params is not None:

@@ -162,3 +162,73 @@ def test_legacy_state_resume_still_works(tmp_path):
     o.set_end_when(optim.max_iteration(10))
     trained = o.optimize()
     assert trained is model
+
+
+class TestRemoteCheckpointIO:
+    """fsspec-routed checkpoint paths (reference File.scala:62-113 routes
+    non-local URIs through the Hadoop FileSystem API; here any URL scheme
+    goes through fsspec). memory:// is the in-process stand-in for
+    gs://hdfs:// — same code path, no network."""
+
+    def _clear(self):
+        fsspec = pytest.importorskip("fsspec")
+        from fsspec.implementations.memory import MemoryFileSystem
+        MemoryFileSystem.store.clear()
+
+    def test_save_load_url_roundtrip(self):
+        self._clear()
+        obj = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+               "meta": {"epoch": 3, "name": "ck"}}
+        url = "memory://ckpts/run1/state.2"
+        bfile.save(obj, url)
+        back = bfile.load(url)
+        np.testing.assert_array_equal(back["w"], obj["w"])
+        assert back["meta"] == obj["meta"]
+        # overwrite protection applies to remote paths too
+        with pytest.raises(FileExistsError):
+            bfile.save(obj, url)
+        bfile.save(obj, url, overwrite=True)
+
+    def test_save_load_module_url(self):
+        self._clear()
+        import jax
+        model = make_model()
+        model.materialize(jax.random.PRNGKey(0))
+        model.evaluate()
+        x = np.random.RandomState(1).rand(4, 2).astype(np.float32)
+        want = np.asarray(model.forward(x))
+        url = "memory://ckpts/model.7"
+        bfile.save_module(model, url)
+        loaded = bfile.load_module(url)
+        loaded.evaluate()
+        got = np.asarray(loaded.forward(x))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_optimizer_checkpoint_to_url(self, tmp_path):
+        """End-to-end: Optimizer.set_checkpoint with a memory:// directory
+        writes model+state snapshots readable by load/load_module, and a
+        local-path run of the same seeded recipe produces the identical
+        checkpoint (remote IO is a pure transport swap)."""
+        self._clear()
+
+        def run(ck_path):
+            RandomGenerator.set_seed(7)
+            model = make_model()
+            ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+            o = optim.Optimizer(model=model, dataset=ds,
+                                criterion=nn.ClassNLLCriterion())
+            o.set_checkpoint(ck_path, optim.several_iteration(4))
+            o.set_end_when(optim.max_iteration(8))
+            o.optimize()
+
+        run("memory://ckdir")
+        run(str(tmp_path / "ckdir"))
+        state = bfile.load("memory://ckdir/state.8")
+        assert int(state["neval"]) == 8
+        m_remote = bfile.load_module("memory://ckdir/model.8")
+        m_local = bfile.load_module(str(tmp_path / "ckdir" / "model.8"))
+        m_remote.evaluate()
+        m_local.evaluate()
+        x = np.random.RandomState(2).rand(4, 2).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(m_remote.forward(x)),
+                                      np.asarray(m_local.forward(x)))

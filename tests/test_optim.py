@@ -355,6 +355,21 @@ def test_sgd_default_decay_applies_after_warmup():
                                rtol=1e-6)
 
 
+def test_sgd_default_decay_nested_warmup():
+    """Advisor r2: Warmup(Warmup(Default)) must subtract BOTH warmup
+    spans before applying Default's 1/(1+n*decay), not just the
+    outermost one."""
+    from bigdl_tpu.optim import SGD, Warmup
+    sgd = SGD(learning_rate=1.0, learning_rate_decay=0.5,
+              learning_rate_schedule=Warmup(3, Warmup(2)))
+    state = sgd.init_state({"w": jnp.zeros((1,))})
+    lrs = [float(sgd.current_lr(dict(state, neval=jnp.asarray(n))))
+           for n in range(5, 9)]
+    # decay counts from neval - (3 + 2)
+    np.testing.assert_allclose(lrs, [1/(1+0.5*k) for k in range(4)],
+                               rtol=1e-6)
+
+
 class TestGradientClipping:
     def _setup(self):
         from bigdl_tpu.dataset import dataset as ds
