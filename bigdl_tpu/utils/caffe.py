@@ -18,8 +18,18 @@ Layout compatibility notes (why a flat copy is correct):
 - Caffe convolution blobs are (out, in/group, kH, kW) — exactly this
   repo's SpatialConvolution weight layout (nn/conv.py).
 - Caffe InnerProduct blobs are (out, in) — exactly Linear's (y = x W^T).
-- BatchNorm/Scale layers differ structurally from Torch BN; import those
-  by name into SpatialBatchNormalization's weight/bias the same way.
+- Caffe splits Torch-style BN across TWO layers: a ``BatchNorm`` layer
+  holding [mean, variance, scale_factor] (the statistics must be divided
+  by scale_factor[0] — caffe accumulates unnormalized sums there) and a
+  following ``Scale`` layer holding [gamma, beta]. When the target module
+  is a BatchNormalization, the loader detects either layer by name,
+  resolves its companion through the prototxt topology (the Scale whose
+  bottom is the BatchNorm's top, or vice versa), writes the normalized
+  statistics into running_mean/running_var, and gamma/beta into
+  weight/bias (γ=1, β=0 when no Scale companion exists — caffe's
+  BatchNorm alone applies no affine). This goes beyond the reference
+  loader (CaffeLoader.scala:85-151 copies blob0→weight blob1→bias
+  blindly, which silently mis-imports real ResNet BN statistics).
 """
 from __future__ import annotations
 
@@ -74,11 +84,11 @@ def _fields(buf: bytes) -> Iterator[tuple[int, int, bytes | int]]:
                              f"(field {fnum} at byte {pos})")
 
 
-def _packed_or_single_f32(out: list, wtype, payload):
-    if wtype == 2:       # packed
-        out.append(np.frombuffer(payload, "<f4"))
-    else:                # unpacked single
-        out.append(np.frombuffer(payload, "<f4"))
+def _f32s(payload) -> np.ndarray:
+    """Float field payloads arrive either packed (wire type 2: N*4 bytes)
+    or as repeated single fixed32 fields (wire type 5: 4 bytes each via
+    ``_fields``); both are raw little-endian f32 bytes."""
+    return np.frombuffer(payload, "<f4")
 
 
 # ---------------------------------------------------------------------------
@@ -102,7 +112,7 @@ class Blob:
         shape: tuple[int, ...] | None = None
         for fnum, wtype, payload in _fields(buf):
             if fnum == 5:        # float data
-                _packed_or_single_f32(data_parts, wtype, payload)
+                data_parts.append(_f32s(payload))
             elif fnum == 8:      # double data
                 data_parts.append(
                     np.frombuffer(payload, "<f8").astype(np.float32))
@@ -291,6 +301,27 @@ def _aslist(v):
     return v if isinstance(v, list) else [v]
 
 
+def _named_modules(model) -> dict:
+    """name -> module for every node of the model tree (LAST wins on
+    duplicate names, matching Container.get_parameters_table's
+    dict.update order so the BN branch pairs state and params from the
+    same module)."""
+    out = {}
+
+    def walk(m):
+        out[m.get_name()] = m
+        for child in getattr(m, "modules", []):
+            walk(child)
+
+    walk(model)
+    return out
+
+
+def _is_bn_module(module) -> bool:
+    from bigdl_tpu.nn.normalization import BatchNormalization
+    return isinstance(module, BatchNormalization)
+
+
 # ---------------------------------------------------------------------------
 # the loader
 # ---------------------------------------------------------------------------
@@ -315,6 +346,89 @@ class CaffeLoader:
             logger.info("load caffe model done (%d layers with blobs: %s)",
                         len(self._layers),
                         [n for n, l in self._layers.items() if l.blobs])
+            self._proto = {}
+            for ldef in (_aslist(self._net_def.get("layer")) +
+                         _aslist(self._net_def.get("layers"))):
+                if isinstance(ldef, dict) and "name" in ldef:
+                    self._proto.setdefault(ldef["name"], ldef)
+
+    # -- BatchNorm/Scale pairing via prototxt topology -------------------
+
+    def _proto_type(self, name: str) -> str:
+        ldef = self._proto.get(name, {})
+        t = ldef.get("type", "")
+        return t if isinstance(t, str) else ""
+
+    def _layer_type(self, name: str) -> str:
+        layer = self._layers.get(name)
+        binary_type = layer.type if layer is not None else ""
+        return binary_type or self._proto_type(name)
+
+    def _companion(self, name: str, want_type: str,
+                   direction: str) -> str | None:
+        """Find the prototxt layer of ``want_type`` wired directly
+        after (direction='down': its bottom == name's top) or before
+        (direction='up': its top == name's bottom) layer ``name``."""
+        ldef = self._proto.get(name)
+        if ldef is None:
+            return None
+        key, other = (("top", "bottom") if direction == "down"
+                      else ("bottom", "top"))
+        anchors = _aslist(ldef.get(key))
+        if not anchors:
+            return None
+        for cand in self._proto.values():
+            if cand.get("type") == want_type and \
+                    _aslist(cand.get(other))[:1] == anchors[:1]:
+                return cand.get("name")
+        return None
+
+    def _copy_batchnorm(self, name: str, module, params: dict):
+        """Import caffe's split BatchNorm(+Scale) into one torch-style BN
+        module: statistics normalized by blob[2]'s scale factor, affine
+        from the companion Scale layer (see module docstring)."""
+        import jax.numpy as jnp
+        if self._layer_type(name) == "BatchNorm":
+            bn_name, scale_name = name, self._companion(name, "Scale",
+                                                        "down")
+        else:   # matched by the Scale layer's name
+            bn_name = self._companion(name, "BatchNorm", "up")
+            scale_name = name
+        if bn_name is not None and self._get_blob(bn_name, 0) is not None:
+            mean = self._get_blob(bn_name, 0).data
+            var_blob = self._get_blob(bn_name, 1)
+            var = (var_blob.data if var_blob is not None
+                   else np.ones_like(mean))
+            sf_blob = self._get_blob(bn_name, 2)
+            # caffe BatchNormLayer: factor = sf==0 ? 0 : 1/sf, stats are
+            # blob * factor (blobs hold unnormalized running sums)
+            if sf_blob is not None and sf_blob.data.size:
+                sf = float(sf_blob.data[0])
+                factor = 0.0 if sf == 0.0 else 1.0 / sf
+                mean, var = mean * factor, var * factor
+            state = module.state
+            for key, val in (("running_mean", mean), ("running_var", var)):
+                tgt = state[key]
+                if int(np.prod(tgt.shape)) != val.size:
+                    raise ValueError(
+                        f"{key} element number is not equal between caffe "
+                        f"layer {bn_name} and bigdl module {name}")
+                state[key] = jnp.asarray(val.reshape(tgt.shape), tgt.dtype)
+            logger.info("load BN statistics for %s from %s (scale factor "
+                        "normalized)", name, bn_name)
+        if "weight" in params:
+            if scale_name is not None and \
+                    self._get_blob(scale_name, 0) is not None:
+                self._copy_one(scale_name, params, "weight", 0,
+                               log_name=name)
+                if self._get_blob(scale_name, 1) is not None:
+                    self._copy_one(scale_name, params, "bias", 1,
+                                   log_name=name)
+            else:
+                # caffe BatchNorm without a Scale layer applies no affine
+                params["weight"] = jnp.ones_like(params["weight"])
+                if "bias" in params:
+                    params["bias"] = jnp.zeros_like(params["bias"])
 
     def _get_blob(self, name: str, ind: int) -> Blob | None:
         layer = self._layers.get(name)
@@ -322,7 +436,8 @@ class CaffeLoader:
             return layer.blobs[ind]
         return None
 
-    def _copy_one(self, name: str, params: dict, key: str, ind: int):
+    def _copy_one(self, name: str, params: dict, key: str, ind: int,
+                  log_name: str | None = None):
         blob = self._get_blob(name, ind)
         if blob is None:
             return
@@ -332,8 +447,8 @@ class CaffeLoader:
         if int(np.prod(target.shape)) != blob.data.size:
             raise ValueError(
                 f"{key} element number is not equal between caffe layer and "
-                f"bigdl module {name}, data shape in caffe is {blob.shape}, "
-                f"while data shape in bigdl is {target.shape}")
+                f"bigdl module {log_name or name}, data shape in caffe is "
+                f"{blob.shape}, while data shape in bigdl is {target.shape}")
         import jax.numpy as jnp
         params[key] = jnp.asarray(
             blob.data.reshape(target.shape), dtype=target.dtype)
@@ -345,6 +460,7 @@ class CaffeLoader:
         if hasattr(model, "materialize"):
             model.materialize()
         table = model.get_parameters_table()
+        named = _named_modules(model)
         for name, params in table.items():
             if not isinstance(params, dict) or \
                     ("weight" not in params and "bias" not in params):
@@ -356,6 +472,11 @@ class CaffeLoader:
                 logger.info("%s uses initialized parameters", name)
                 continue
             logger.info("load parameters for %s ...", name)
+            module = named.get(name)
+            if _is_bn_module(module) and \
+                    self._layer_type(name) in ("BatchNorm", "Scale"):
+                self._copy_batchnorm(name, module, params)
+                continue
             self._copy_one(name, params, "weight", 0)
             self._copy_one(name, params, "bias", 1)
         # re-sync facades: container params reference the mutated child

@@ -9,10 +9,12 @@ free of Java-serialization's fragility. Paths with a URL scheme
 (``file://``, ``gs://``, ``hdfs://``, ``s3://``, ``memory://`` …) are
 routed through fsspec — the Python ecosystem's Hadoop-FileSystem
 equivalent; plain paths use the local FS directly and never import
-fsspec. Crash safety: local paths stream to a sibling ``.tmp`` then
-rename; URL paths write the target object directly, since a
-single-object PUT is already atomic on object stores (a rename there
-would be copy+delete — weaker, not stronger).
+fsspec. Crash safety: both branches stage to a sibling ``.tmp`` then
+move, so the target name never holds a torn file — on local FS the move
+is an atomic rename, on object stores it is copy(atomic PUT)+delete,
+which at worst strands a ``.tmp`` object; a failed write discards the
+staged upload (or deletes the partial ``.tmp``) and leaves the previous
+checkpoint untouched.
 """
 from __future__ import annotations
 
@@ -69,28 +71,28 @@ def _open_write_atomic(path: str):
     """Yield a writable binary stream that lands at ``path`` only on a
     clean exit (reference File.scala:62-113 saveToHdfs semantics)."""
     if _is_url(path):
+        # stage to a sibling name on every backend: write-in-place
+        # filesystems (file://, memory://) would otherwise truncate the
+        # previous checkpoint at open() and lose it on a failed write
         fs = _fs_for(path)
         dirname = path.rsplit("/", 1)[0]
         if dirname and dirname != path:
             fs.makedirs(dirname, exist_ok=True)
-        f = fs.open(path, "wb")
+        url_tmp = path + ".tmp"
+        f = fs.open(url_tmp, "wb")
         try:
             yield f
         except BaseException:
-            # don't let close() commit a truncated object over the last
-            # good checkpoint: staged-upload backends (gcsfs/s3fs —
-            # AbstractBufferedFile) abort the pending upload, leaving
-            # the previous object untouched; write-in-place backends
-            # (memory://) get the partial object deleted instead
             import fsspec
             if isinstance(f, fsspec.spec.AbstractBufferedFile):
-                f.discard()
+                f.discard()        # abort the staged upload
             else:
                 f.close()
-                with contextlib.suppress(Exception):
-                    fs.rm(path)
+            with contextlib.suppress(Exception):
+                fs.rm(url_tmp)
             raise
         f.close()
+        fs.mv(url_tmp, path)
         return
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -18,11 +18,17 @@ Torch serialization used for Torch interop (``Module.loadTorch`` /
 Indices form a shared-object registry: a TORCH/TABLE with an
 already-seen index is a back-reference (TorchFile.scala:213-249).
 
-Supported module classes cover the model-zoo set (Sequential, Linear,
-SpatialConvolution(+MM), pooling, ReLU/Tanh/Sigmoid/LogSoftMax, View,
-Reshape, Dropout, (Spatial)BatchNormalization, Threshold, CAddTable,
-ConcatTable, Concat) — tensors map to/from numpy, torch (out,in[,kH,kW])
-layouts match this repo's parameter layouts directly.
+Supported module classes cover the reference writer's set
+(TorchFile.scala:443-620) and the full CNN zoo: Sequential, Concat,
+ConcatTable, Linear, SpatialConvolution(+MM), max/avg pooling,
+ReLU/Tanh/Sigmoid/SoftMax/LogSoftMax/Threshold/PReLU, View, Reshape,
+Dropout, (Spatial)BatchNormalization, SpatialCrossMapLRN, CAddTable,
+CMulTable, CAdd/CMul, LookupTable, SplitTable/JoinTable,
+SpatialZeroPadding, Mul/AddConstant, Identity (Remat wrappers serialize
+as their forward-equivalent inner module). Tensors map to/from numpy;
+torch (out,in[,kH,kW]) layouts match this repo's parameter layouts
+directly. save_torch/load_torch round-trips every CNN zoo model
+including ResNet (tests/test_torchfile.py TestZooRoundTrip).
 """
 from __future__ import annotations
 
@@ -288,10 +294,54 @@ def _build_module(cls_name: str, e: TorchTable):
     if name == "nn.Dropout":
         return nn.Dropout(float(e.get("p", 0.5)))
     if name == "nn.CAddTable":
-        return nn.CAddTable()
+        return nn.CAddTable(bool(e.get("inplace", False)))
+    if name == "nn.CMulTable":
+        return nn.CMulTable()
     if name == "nn.Identity":
         return nn.Identity()
+    if name == "nn.LookupTable":
+        w = e["weight"]
+        m = nn.LookupTable(w.shape[0], w.shape[1],
+                           padding_value=float(e.get("paddingValue", 0)),
+                           max_norm=e.get("maxNorm"))
+        return _set_params(m, weight=w)
+    if name == "nn.PReLU":
+        return _set_params(nn.PReLU(int(e.get("nOutputPlane", 0))),
+                           weight=e["weight"])
+    if name == "nn.CMul":
+        return _set_params(nn.CMul(_int_sizes(e["size"])),
+                           weight=e["weight"])
+    if name == "nn.CAdd":
+        return _set_params(nn.CAdd(_int_sizes(e["size"])), bias=e["bias"])
+    if name == "nn.SpatialCrossMapLRN":
+        return nn.SpatialCrossMapLRN(
+            int(e.get("size", 5)), float(e.get("alpha", 1.0)),
+            float(e.get("beta", 0.75)), float(e.get("k", 1.0)))
+    if name == "nn.SplitTable":
+        return nn.SplitTable(int(e["dimension"]) - 1,
+                             int(e.get("nInputDims", -1)))
+    if name == "nn.JoinTable":
+        return nn.JoinTable(int(e["dimension"]) - 1,
+                            int(e.get("nInputDims", -1)))
+    if name == "nn.SpatialZeroPadding":
+        return nn.SpatialZeroPadding(
+            int(e["pad_l"]), int(e["pad_r"]), int(e["pad_t"]),
+            int(e["pad_b"]))
+    if name == "nn.MulConstant":
+        return nn.MulConstant(float(e["constant_scalar"]),
+                              bool(e.get("inplace", False)))
+    if name == "nn.AddConstant":
+        return nn.AddConstant(float(e["constant_scalar"]),
+                              bool(e.get("inplace", False)))
     raise ValueError(f"unsupported torch module {cls_name}")
+
+
+def _int_sizes(v) -> tuple:
+    """A torch size field arrives as a LongStorage tensor or a lua
+    table/array — normalize to a tuple of ints."""
+    if isinstance(v, TorchTable):
+        return tuple(int(s) for s in v.array())
+    return tuple(int(s) for s in np.asarray(v).reshape(-1))
 
 
 # ---------------------------------------------------------------------------
@@ -408,6 +458,10 @@ def _module_to_table(m) -> tuple[str, dict]:
     """bigdl_tpu module -> (torch class name, field table) (reference
     write<Module> family, TorchFile.scala:443-620)."""
     from bigdl_tpu import nn
+    if isinstance(m, nn.Remat):
+        # torch7 has no remat wrapper; the inner module is
+        # forward-equivalent (nn/containers.py Remat is pytree-transparent)
+        return _module_to_table(m.modules[0])
     t: dict = {"_type": "torch.FloatTensor", "train": m.is_training()}
     p = m.params or {}
     if isinstance(m, (nn.Sequential, nn.Concat, nn.ConcatTable)):
@@ -484,6 +538,61 @@ def _module_to_table(m) -> tuple[str, dict]:
         return "nn.Dropout", t
     if isinstance(m, nn.Identity):
         return "nn.Identity", t
+    if isinstance(m, nn.SoftMax):
+        return "nn.SoftMax", t
+    if isinstance(m, nn.Threshold):
+        t.update(threshold=float(m.th), val=float(m.value), inplace=False)
+        return "nn.Threshold", t
+    if isinstance(m, nn.CAddTable):
+        t["inplace"] = bool(getattr(m, "inplace", False))
+        return "nn.CAddTable", t
+    if isinstance(m, nn.CMulTable):
+        return "nn.CMulTable", t
+    if isinstance(m, nn.LookupTable):
+        t.update(weight=_np(p["weight"]),
+                 gradWeight=np.zeros_like(_np(p["weight"])),
+                 nIndex=float(m.n_index), nOutput=float(m.n_output),
+                 paddingValue=float(m.padding_value))
+        if m.max_norm is not None:
+            t["maxNorm"] = float(m.max_norm)
+        return "nn.LookupTable", t
+    if isinstance(m, nn.PReLU):
+        t.update(weight=_np(p["weight"]),
+                 gradWeight=np.zeros_like(_np(p["weight"])),
+                 nOutputPlane=float(m.n_output_plane))
+        return "nn.PReLU", t
+    if isinstance(m, nn.CMul):
+        t.update(weight=_np(p["weight"]),
+                 gradWeight=np.zeros_like(_np(p["weight"])),
+                 size=np.asarray(m.size, np.int64))
+        return "nn.CMul", t
+    if isinstance(m, nn.CAdd):
+        t.update(bias=_np(p["bias"]),
+                 gradBias=np.zeros_like(_np(p["bias"])),
+                 size=np.asarray(m.size, np.int64))
+        return "nn.CAdd", t
+    if isinstance(m, nn.SpatialCrossMapLRN):
+        t.update(size=float(m.size), alpha=float(m.alpha),
+                 beta=float(m.beta), k=float(m.k))
+        return "nn.SpatialCrossMapLRN", t
+    if isinstance(m, nn.SplitTable):
+        t.update(dimension=float(m.dimension + 1),
+                 nInputDims=float(m.n_input_dims))
+        return "nn.SplitTable", t
+    if isinstance(m, nn.JoinTable):
+        t.update(dimension=float(m.dimension + 1),
+                 nInputDims=float(m.n_input_dims))
+        return "nn.JoinTable", t
+    if isinstance(m, nn.SpatialZeroPadding):
+        t.update(pad_l=float(m.pl), pad_r=float(m.pr), pad_t=float(m.pt),
+                 pad_b=float(m.pb))
+        return "nn.SpatialZeroPadding", t
+    if isinstance(m, nn.MulConstant):
+        t.update(constant_scalar=float(m.constant), inplace=False)
+        return "nn.MulConstant", t
+    if isinstance(m, nn.AddConstant):
+        t.update(constant_scalar=float(m.constant), inplace=False)
+        return "nn.AddConstant", t
     raise ValueError(f"saveTorch: unsupported module {type(m).__name__}")
 
 
