@@ -18,7 +18,8 @@ reference's hand-threaded Concat copies (nn/Concat.scala:42-80) away.
 """
 from __future__ import annotations
 
-from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU, Remat,
+from bigdl_tpu.nn import (Concat, Dropout, Linear, LogSoftMax, ReLU,
+                          ReLUCrossMapLRN, Remat,
                           Sequential, SpatialAveragePooling,
                           SpatialBatchNormalization, SpatialConvolution,
                           SpatialCrossMapLRN, SpatialMaxPooling, View)
@@ -89,8 +90,11 @@ def _v1_stem():
             .add(SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1,
                                     init_method=init_mod.Xavier)
                  .set_name("conv2/3x3"))
-            .add(ReLU().set_name("conv2/relu_3x3"))
-            .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+            # single-HBM-pass ReLU+LRN (nn.ReLUCrossMapLRN docstring);
+            # child modules keep the reference names
+            .add(ReLUCrossMapLRN(
+                ReLU().set_name("conv2/relu_3x3"),
+                SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2")))
             .add(SpatialMaxPooling(3, 3, 2, 2).ceil()
                  .set_name("pool2/3x3_s2")))
 

@@ -18,8 +18,9 @@ from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
                                   RoiPooling)
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN,
-    Normalize, SpatialDivisiveNormalization, SpatialSubtractiveNormalization,
-    SpatialContrastiveNormalization, LayerNorm)
+    ReLUCrossMapLRN, Normalize, SpatialDivisiveNormalization,
+    SpatialSubtractiveNormalization, SpatialContrastiveNormalization,
+    LayerNorm)
 from bigdl_tpu.nn.dropout import Dropout, L1Penalty
 from bigdl_tpu.nn.structural import (
     Reshape, InferReshape, View, Transpose, Squeeze, Unsqueeze, Select,

@@ -17,12 +17,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.nn.containers import Sequential as _Sequential
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.ops import pow_neg_beta as _pow_neg_beta
 from bigdl_tpu.tensor import default_dtype
 
 __all__ = ["BatchNormalization", "SpatialBatchNormalization",
-           "SpatialCrossMapLRN", "Normalize", "LayerNorm",
+           "SpatialCrossMapLRN", "ReLUCrossMapLRN", "Normalize", "LayerNorm",
            "SpatialDivisiveNormalization", "SpatialSubtractiveNormalization",
            "SpatialContrastiveNormalization"]
 
@@ -183,6 +184,35 @@ class SpatialCrossMapLRN(Module):
         else:
             y = _lrn(x, self.size, self.alpha, self.beta, self.k)
         return y, state
+
+
+class ReLUCrossMapLRN(_Sequential):
+    """TPU fusion of ReLU -> SpatialCrossMapLRN in ONE HBM pass.
+
+    A Sequential of the two child modules — child names, the (name-keyed)
+    parameter table, and .t7 export stay reference-faithful, and the
+    fused forward is equivalent to running the children in order (both
+    are parameter-free). Note: introducing the wrapper into a model DOES
+    shift that model's index-keyed Sequential pytree (sibling indices
+    change), like any structural edit — raw ``save``d checkpoints from
+    before the edit don't line up, name-based flows (Caffe/Torch import,
+    parameter table) do. On TPU the Pallas kernel applies the ReLU in
+    VMEM, eliminating
+    the standalone elementwise read+write of the activation (profiled on
+    Inception-v1: the conv2/relu_3x3 pass alone moves ~620 MB/step at
+    batch 256); elsewhere the Sequential fallback runs the children.
+    """
+
+    def __init__(self, relu, lrn):
+        super().__init__(relu, lrn)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        from bigdl_tpu.ops.pallas import lrn as plrn
+        m = self.modules[1]
+        if plrn.lrn_supported(x):
+            return plrn.lrn(x, m.size, m.alpha, m.beta, m.k,
+                            relu=True), state
+        return super().apply(params, state, x, training=training, rng=rng)
 
 
 class Normalize(Module):

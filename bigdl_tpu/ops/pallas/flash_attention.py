@@ -58,11 +58,14 @@ _NEG = -1e9  # finite mask value, matches parallel/sequence.py
 
 def flash_supported(q, k) -> bool:
     """Kernel constraints: TPU backend, block-divisible sequence lengths,
-    a head dim the MXU tiles cleanly (lane-width multiple)."""
+    a head dim Mosaic tiles cleanly. D=64 — the most common transformer
+    geometry — engages the kernel (round 3: Mosaic pads the 64-lane
+    minor dim internally; measured faster than the XLA fallback, which
+    the old ``d % 128`` guard silently forced)."""
     return (jax.default_backend() == "tpu"
             and q.shape[1] % _Q_BLOCKS[-1] == 0
             and k.shape[1] % _K_BLOCKS[-1] == 0
-            and q.shape[-1] % 128 == 0)
+            and q.shape[-1] % 64 == 0)
 
 
 def _causal_mask(s, qi, ki, bq, bk):
@@ -90,10 +93,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # causal: tiles entirely above the diagonal contribute exactly zero
     # (exp(_NEG - m) underflows); skip their FLOPs at the grid level
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # matmuls stay in the storage dtype (bf16 on the MXU at full rate,
+        # f32 accumulation via preferred_element_type) — converting inputs
+        # to f32 first runs the MXU at its 1/4-1/8 f32 rate and was the
+        # round-2 kernel's S=2048 parity problem (docs/PERF.md round 3)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
         m_prev = m_scr[:]
@@ -101,8 +106,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0].astype(jnp.float32)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * corr + pv
         m_scr[:] = m_new
@@ -159,20 +164,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
         p = jnp.exp(s - lse_ref[0])
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -196,24 +198,22 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
         p = jnp.exp(s - lse_ref[0])                     # (BQ, BK)
-        do = do_ref[0].astype(jnp.float32)              # (BQ, D)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])                    # (BQ, BK)
-        # dk accumulates ds^T (q*scale); the q ref already carries scale
+        # dk accumulates ds^T q * scale (ds here carries no scale; fold it
+        # at the end would change dq too — apply to the addend directly)
+        ds = p * (dp - delta_ref[0]) * scale            # (BQ, BK)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:

@@ -57,6 +57,27 @@ class DistriOptimizer(Optimizer):
         # (parallel/tensor_parallel.py)
         self.tensor_parallel = tensor_parallel
 
+    def _account_collectives(self, compiled, n_devices: int) -> None:
+        """Static per-step collective-bytes accounting from the compiled
+        HLO — the XLA-era equivalent of the reference's put/get-gradient
+        phase instrumentation (AllReduceParameter.scala:134-228). Runs
+        once per compile; read back via ``metrics.summary()``."""
+        from bigdl_tpu.parallel.collective_bench import collective_bytes
+        try:
+            acct = collective_bytes(compiled.as_text(), n_devices)
+        except Exception as e:   # accounting must never break training
+            logger.debug(f"collective accounting unavailable: {e}")
+            return
+        self.metrics.set("collective ops per step", acct["ops"])
+        self.metrics.set("collective logical bytes per step",
+                         acct["logical_bytes"])
+        self.metrics.set("collective wire bytes per chip per step",
+                         acct["wire_bytes_per_chip"])
+        logger.info(
+            "collectives per step: %d ops, %.1f MB logical, %.1f MB wire "
+            "per chip (ring estimate)", acct["ops"],
+            acct["logical_bytes"] / 1e6, acct["wire_bytes_per_chip"] / 1e6)
+
     def _shard_batch(self, data, labels, sharding):
         """Lay a host batch out across the data axis.
 
@@ -136,6 +157,9 @@ class DistriOptimizer(Optimizer):
             in_shardings=(param_shard, repl, opt_shard, repl, batch_shard,
                           batch_shard, None),
             out_shardings=(param_shard, repl, opt_shard, repl))
+        compiled_steps = {}    # batch shape -> AOT executable (partial
+                               # final batches recompile, like jit would);
+                               # collective accounting reads the first HLO
 
         def eval_apply(params, mstate, data):
             out, _ = model.apply(params, mstate, data, training=False)
@@ -195,9 +219,19 @@ class DistriOptimizer(Optimizer):
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
-            params, mstate, opt_state, loss = jit_step(
+            epoch_arr = jnp.asarray(driver_state["epoch"], jnp.int32)
+            shape_key = (data.shape, labels.shape)
+            compiled_this_iter = shape_key not in compiled_steps
+            if compiled_this_iter:
+                compiled = jit_step.lower(
+                    params, mstate, opt_state, step_rng, data, labels,
+                    epoch_arr).compile()
+                if not compiled_steps:
+                    self._account_collectives(compiled, n_shards)
+                compiled_steps[shape_key] = compiled
+            params, mstate, opt_state, loss = compiled_steps[shape_key](
                 params, mstate, opt_state, step_rng, data, labels,
-                jnp.asarray(driver_state["epoch"], jnp.int32))
+                epoch_arr)
             loss = float(loss)
             t2 = time.perf_counter()
             device_time = t2 - t1
@@ -219,6 +253,16 @@ class DistriOptimizer(Optimizer):
             # measurable is host input vs device step (see metrics.py)
             self.metrics.record("device step time", device_time)
             self.metrics.record("host input time", data_time)
+            wire = self.metrics.get("collective wire bytes per chip per step")
+            if wire > 0 and not compiled_this_iter:
+                # device step time >= collective time, so this is a LOWER
+                # bound on link bandwidth — the honest in-training readout
+                # (the isolated figure comes from parallel/collective_bench);
+                # compile iterations are excluded, their wall time is
+                # compilation, not the link
+                self.metrics.record(
+                    "allreduce GB/s (wire bytes / device step, lower bound)",
+                    wire / device_time / 1e9)
             if logger.isEnabledFor(logging.DEBUG):
                 logger.debug(self.metrics.summary())
             driver_state["neval"] += 1

@@ -74,7 +74,7 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                 f"flash=True but the kernel does not support this call: "
                 f"backend={jax.default_backend()}, q{q.shape} k{k.shape}, "
                 f"q_offset={q_offset} kv_offset={kv_offset} (need TPU, "
-                f"seq % 128 == 0, head_dim % 128 == 0, zero offsets when "
+                f"seq % 128 == 0, head_dim % 64 == 0, zero offsets when "
                 f"causal)")
         if supported:
             return flash_attention(q, k, v, causal=causal, scale=scale)
@@ -186,7 +186,8 @@ def _ring_body(q, k, v, *, axis, n, causal, scale):
     return out.astype(q.dtype)
 
 
-def _flash_ring_ok(q, k, q_local, kv_local, causal, flash):
+def _flash_ring_ok(q, k, q_local, kv_local, causal, flash,
+                   interpret=False):
     """Whether the per-shard flash path applies (mirrors flash_supported,
     but on the LOCAL shard lengths). ``flash=True`` raises when the
     kernel cannot serve the call — same contract as
@@ -203,15 +204,22 @@ def _flash_ring_ok(q, k, q_local, kv_local, causal, flash):
     from bigdl_tpu.ops.pallas.flash_attention import _Q_BLOCKS
     shapes_ok = (q_local % _Q_BLOCKS[-1] == 0
                  and kv_local % _Q_BLOCKS[-1] == 0
-                 and k.shape[-1] % 128 == 0
+                 and k.shape[-1] % 64 == 0
                  and not (causal and q_local != kv_local))
     if flash is True and not shapes_ok:
         raise ValueError(
             f"flash=True but the ring flash path does not support this "
             f"call: local shards q={q_local} kv={kv_local}, "
             f"head_dim={k.shape[-1]}, causal={causal} (need shard "
-            f"lengths % 128 == 0, head_dim % 128 == 0, and equal q/kv "
+            f"lengths % 128 == 0, head_dim % 64 == 0, and equal q/kv "
             f"shard lengths when causal)")
+    if flash is True and not interpret and jax.default_backend() != "tpu":
+        # advisor r2: without this the compiled Pallas lowering fails
+        # deep inside Mosaic with an obscure error on CPU/GPU
+        raise ValueError(
+            "flash=True requires the TPU backend (or interpret=True for "
+            "CPU testing); this process is running on "
+            f"'{jax.default_backend()}'")
     if flash == "auto":
         return shapes_ok and jax.default_backend() == "tpu"
     return shapes_ok
@@ -240,7 +248,7 @@ def ring_attention(q, k, v, *, causal: bool = False,
             f"mesh axis '{axis}' size {n}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     use_flash = _flash_ring_ok(q, k, q.shape[1] // n, k.shape[1] // n,
-                               causal, flash)
+                               causal, flash, interpret)
 
     def body(qb, kb, vb):
         if use_flash:
@@ -264,7 +272,8 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
     ``q``/``k``/``v`` are already the local sequence blocks."""
     n = axis_size if axis_size is not None else jax.lax.axis_size(axis)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if _flash_ring_ok(q, k, q.shape[1], k.shape[1], causal, flash):
+    if _flash_ring_ok(q, k, q.shape[1], k.shape[1], causal, flash,
+                      interpret):
         return _ring_body_flash(q, k, v, axis=axis, n=n, causal=causal,
                                 scale=scale, interpret=interpret)
     return _ring_body(q, k, v, axis=axis, n=n, causal=causal, scale=scale)

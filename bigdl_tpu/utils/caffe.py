@@ -461,6 +461,14 @@ class CaffeLoader:
             model.materialize()
         table = model.get_parameters_table()
         named = _named_modules(model)
+        # affine=False BatchNormalization has NO weight/bias entry in the
+        # table, but its statistics still import — walk it by module
+        for name, module in named.items():
+            if _is_bn_module(module) and not module.affine and \
+                    name in self._layers and \
+                    self._layer_type(name) == "BatchNorm":
+                logger.info("load parameters for %s ...", name)
+                self._copy_batchnorm(name, module, {})
         for name, params in table.items():
             if not isinstance(params, dict) or \
                     ("weight" not in params and "bias" not in params):

@@ -319,3 +319,20 @@ layer { name: "bn" type: "BatchNorm" bottom: "conv_out" top: "conv_out" }
         np.testing.assert_allclose(root_mean,
                                    np.asarray(self.MEAN_RAW) / self.SF,
                                    rtol=1e-6)
+
+    def test_affine_false_bn_stats_still_import(self, tmp_path):
+        """Review r3: affine=False BN has no weight/bias table entry but
+        its statistics must still be found and normalized."""
+        proto, cm = self._write(tmp_path, with_scale=False)
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(1, 3, 3, 3).set_name("conv"))
+                 .add(nn.SpatialBatchNormalization(3, affine=False)
+                      .set_name("bn")))
+        load_caffe(model, proto, cm)
+        bn = model.modules[1]
+        np.testing.assert_allclose(np.asarray(bn.state["running_mean"]),
+                                   np.asarray(self.MEAN_RAW) / self.SF,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(bn.state["running_var"]),
+                                   np.asarray(self.VAR_RAW) / self.SF,
+                                   rtol=1e-6)

@@ -12,6 +12,7 @@ import pytest
 import bigdl_tpu.nn as nn
 import bigdl_tpu.optim as optim
 from bigdl_tpu.dataset import Sample, array, SampleToBatch
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel import Engine, get_mesh, data_sharding
 
 
@@ -196,3 +197,82 @@ class TestDistriOptimizer:
         for a, b in zip(jax.tree.leaves(g_local), jax.tree.leaves(g_dist)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestCollectiveAccounting:
+    """The second BASELINE metric: allreduce bytes/GB-s instrumentation
+    (VERDICT r2 missing #1; reference AllReduceParameter.scala:134-228)."""
+
+    def test_distri_metrics_report_collective_bytes(self):
+        mesh = Engine.init(axes={"data": 8})
+        model = make_mlp()
+        ds = make_dataset() >> SampleToBatch(64, drop_remainder=True)
+        o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  mesh=mesh)
+        o.set_end_when(optim.max_iteration(3))
+        o.optimize()
+        logical = o.metrics.get("collective logical bytes per step")
+        wire = o.metrics.get("collective wire bytes per chip per step")
+        # the gradient allreduce moves at least the full f32 param tree
+        n_params = sum(np.prod(p.shape) for p in
+                       jax.tree.leaves(model.params))
+        assert logical >= 4 * n_params, (logical, n_params)
+        # ring wire estimate: 2*(N-1)/N per all-reduced byte
+        assert wire == pytest.approx(logical * 2 * 7 / 8, rel=0.5)
+        summary = o.metrics.summary()
+        assert "collective wire bytes per chip per step" in summary
+        assert "allreduce GB/s" in summary
+
+    def test_single_device_reports_zero(self):
+        mesh = Engine.init(axes={"data": 1}, devices=jax.devices()[:1])
+        model = make_mlp()
+        ds = make_dataset() >> SampleToBatch(64, drop_remainder=True)
+        o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  mesh=mesh)
+        o.set_end_when(optim.max_iteration(2))
+        o.optimize()
+        assert o.metrics.get("collective logical bytes per step") == 0
+        assert "allreduce GB/s" not in o.metrics.summary()
+
+    def test_allreduce_bench_runs_and_accounts(self):
+        from bigdl_tpu.parallel.collective_bench import allreduce_bench
+        mesh = Engine.init(axes={"data": 8})
+        out = allreduce_bench(size_mb=0.5, iters=3, warmup=1, mesh=mesh)
+        assert out["devices"] == 8
+        assert out["payload_mb"] >= 0.5
+        assert out["bus_gbps"] > 0 and out["alg_gbps"] > 0
+        # bus = alg * 2*(N-1)/N for a ring allreduce
+        assert out["bus_gbps"] == pytest.approx(
+            out["alg_gbps"] * 2 * 7 / 8, rel=0.01)
+
+    def test_collective_bytes_parser(self):
+        from bigdl_tpu.parallel.collective_bench import collective_bytes
+        hlo = """
+ENTRY %main {
+  %p0 = f32[1024,8]{1,0} parameter(0)
+  %ar = f32[1024,8]{1,0} all-reduce(%p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag-start = (f32[256]{0}, f32[1024]{0}) all-gather-start(%x), replica_groups=[1,4]<=[4], dimensions={0}
+  %ag-done = f32[1024]{0} all-gather-done(%ag-start)
+}
+"""
+        acct = collective_bytes(hlo, 4)
+        assert acct["ops"] == 2
+        ar_bytes = 1024 * 8 * 4
+        assert acct["by_kind"]["all-reduce"] == [1, ar_bytes]
+        assert acct["wire_bytes_per_chip"] == pytest.approx(
+            ar_bytes * 2 * 3 / 4 + (256 * 4 + 1024 * 4) * 3 / 4)
+
+
+def test_distri_partial_final_batch_recompiles():
+    """Review r3: the AOT step executable must handle a final batch whose
+    shape differs (SampleToBatch drop_remainder=False default)."""
+    mesh = Engine.init(axes={"data": 8})
+    model = make_mlp()
+    # 96 samples, batch 64 -> batches of 64 and 32 (both divisible by 8)
+    ds = make_dataset(n=96) >> SampleToBatch(64)
+    o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    o.set_end_when(optim.max_iteration(4))
+    trained = o.optimize()
+    assert trained is model
+    assert np.isfinite(
+        np.asarray(model.forward(np.zeros((4, 2), np.float32)))).all()
