@@ -19,6 +19,8 @@ loudly there.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -98,14 +100,20 @@ def _block_step(bp, x, ck, cv, pos, num_heads, max_len):
                                       (0, start, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                       (0, start, 0, 0))
-    # each query row i (absolute position start+i) sees cache <= start+i
+    # each query row i (absolute position start+i) sees cache <= start+i.
+    # Operands stay in the cache dtype with f32 ACCUMULATION — an
+    # .astype(f32) on the cache materialized a full f32 copy of the
+    # static (B, max_len, H, Dh) buffers per layer per step, which is
+    # what made batch-128 decode REGRESS below batch 64 (2 GB of
+    # converts/step at B=128; round 3, docs/PERF.md)
     upto = start + jnp.arange(t)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   ck.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(ck.dtype), ck,
+                   preferred_element_type=jnp.float32) * scale
     kpos = jnp.arange(max_len)[None, None, None, :]
     s = jnp.where(kpos > upto[None, None, :, None], -1e9, s)
-    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
-                   cv.astype(jnp.float32)).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd",
+                   jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
     o = _proj(mha_p, "out",
               o.reshape(x.shape)).astype(activation_dtype())
     x = x + o
@@ -136,6 +144,28 @@ def _logits(params, num_layers, x):
     return _linear(head, _ln(norm, x[:, -1]))
 
 
+def _prefill(params, prompt, num_layers, num_heads, max_len):
+    """Cache allocation + prompt prefill. Returns (ck, cv, x, pos0)."""
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    head_dim = embed["tok"].shape[1] // num_heads
+    dtype = activation_dtype()
+    b = prompt.shape[0]
+    # per-layer cache TUPLES, not one stacked (L, ...) array: each layer's
+    # cache is then its own scan-carry leaf, which XLA updates in place —
+    # the stacked form's .at[li].set forced whole-cache copies per step
+    # (measured: batch-64 decode 212 -> 4.06 ms/step)
+    zero = lambda: jnp.zeros((b, max_len, num_heads, head_dim), dtype)
+    ck, cv = [], []
+    x = _embed(embed, prompt, 0).astype(dtype)
+    pos0 = prompt.shape[1] - 1
+    for li in range(num_layers):
+        x, k_l, v_l = _block_step(blocks[li], x, zero(), zero(),
+                                  jnp.asarray(pos0), num_heads, max_len)
+        ck.append(k_l)
+        cv.append(v_l)
+    return tuple(ck), tuple(cv), x, pos0
+
+
 def _setup_and_prefill(model, prompt, n_new, params):
     """Shared decode preamble: meta checks, cache allocation, and the
     prompt prefill pass. Returns (params, meta dims, caches, last-layer
@@ -153,60 +183,42 @@ def _setup_and_prefill(model, prompt, n_new, params):
         raise ValueError(f"prompt {p_len} + new {n_new} exceeds the "
                          f"model's max_len {max_len}")
     embed, blocks, _, _ = _model_parts(params, num_layers)
-    head_dim = embed["tok"].shape[1] // num_heads
     dtype = activation_dtype()
-    # per-layer cache TUPLES, not one stacked (L, ...) array: each layer's
-    # cache is then its own scan-carry leaf, which XLA updates in place —
-    # the stacked form's .at[li].set forced whole-cache copies per step
-    # (measured: batch-64 decode 212 -> 4.06 ms/step)
-    zero = lambda: jnp.zeros((b, max_len, num_heads, head_dim), dtype)
-    ck, cv = [], []
-    x = _embed(embed, prompt, 0).astype(dtype)
-    pos0 = p_len - 1
-    for li in range(num_layers):
-        x, k_l, v_l = _block_step(blocks[li], x, zero(), zero(),
-                                  jnp.asarray(pos0), num_heads, max_len)
-        ck.append(k_l)
-        cv.append(v_l)
+    ck, cv, x, pos0 = _prefill(params, prompt, num_layers, num_heads,
+                               max_len)
     return (params, prompt, num_layers, num_heads, max_len, embed,
-            blocks, dtype, tuple(ck), tuple(cv), x, pos0)
+            blocks, dtype, ck, cv, x, pos0)
 
 
-def generate(model, prompt, config: GenerationConfig | None = None, *,
-             rng=None, params=None):
-    """Decode ``config.max_new_tokens`` tokens after ``prompt`` (B, P)
-    1-based token ids. Returns (B, max_new_tokens) generated ids.
+def _sample(logits, key, temperature, top_k):
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1) + 1          # back to 1-based
+    logits = logits / temperature
+    if top_k is not None:
+        k_eff = min(top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return jax.random.categorical(key, logits, axis=-1) + 1
 
-    ``model`` is a materialized ``TransformerLM`` (its ``num_layers``/
-    ``num_heads``/``max_len`` attributes come from the builder); pass
-    ``params`` to decode with externally-updated parameters.
-    """
-    config = config or GenerationConfig()
-    n_new = config.max_new_tokens
-    # activations (and the cache) follow the session dtype policy,
-    # mirroring the module forward path — token-exact parity with
-    # model.apply holds per-policy
-    (params, prompt, num_layers, num_heads, max_len, embed, blocks,
-     dtype, ck, cv, x, pos) = _setup_and_prefill(model, prompt, n_new,
-                                                 params)
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_layers", "num_heads", "max_len", "n_new", "temperature",
+    "top_k", "policy_key"))
+def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
+                   max_len, n_new, temperature, top_k, policy_key):
+    """The whole prefill+decode program as ONE module-level jitted
+    function: repeated ``generate`` calls with the same shapes/config hit
+    the jit cache instead of re-tracing a per-call closure (which
+    recompiled the scan on every call — the dominant cost of the round-2
+    decode numbers when used as an API rather than a one-shot)."""
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    dtype = activation_dtype()
+    ck, cv, x, pos = _prefill(params, prompt, num_layers, num_heads,
+                              max_len)
     logits = _logits(params, num_layers, x)
-
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
-
-    def sample(logits, key):
-        logits = logits.astype(jnp.float32)
-        if config.temperature == 0.0:
-            return jnp.argmax(logits, axis=-1) + 1      # back to 1-based
-        logits = logits / config.temperature
-        if config.top_k is not None:
-            k_eff = min(config.top_k, logits.shape[-1])
-            kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
-            logits = jnp.where(logits < kth, -1e9, logits)
-        return jax.random.categorical(key, logits, axis=-1) + 1
-
     rng, key0 = jax.random.split(rng)
-    first = sample(logits, key0)
+    first = _sample(logits, key0, temperature, top_k)
 
     # ---- decode: lax.scan over the remaining n_new - 1 positions ------
     def step(carry, key):
@@ -218,14 +230,49 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
                 blocks[li], x, ck[li], cv[li], pos + 1, num_heads,
                 max_len)
         logits = _logits(params, num_layers, x)
-        nxt = sample(logits, key)
+        nxt = _sample(logits, key, temperature, top_k)
         return (nxt, tuple(new_ck), tuple(new_cv), pos + 1), nxt
 
     keys = jax.random.split(rng, max(n_new - 1, 1))
     (_, _, _, _), rest = jax.lax.scan(
         step, (first, ck, cv, jnp.asarray(pos)), keys[:n_new - 1])
-    out = jnp.concatenate([first[:, None], rest.T], axis=1)
-    return out
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate(model, prompt, config: GenerationConfig | None = None, *,
+             rng=None, params=None):
+    """Decode ``config.max_new_tokens`` tokens after ``prompt`` (B, P)
+    1-based token ids. Returns (B, max_new_tokens) generated ids.
+
+    ``model`` is a materialized ``TransformerLM`` (its ``num_layers``/
+    ``num_heads``/``max_len`` attributes come from the builder); pass
+    ``params`` to decode with externally-updated parameters. Activations
+    and the KV cache follow the session dtype policy at first trace;
+    repeated calls with the same prompt shape and config reuse the
+    compiled program.
+    """
+    config = config or GenerationConfig()
+    n_new = config.max_new_tokens
+    params = model.params if params is None else params
+    meta = getattr(model, "lm_meta", None)
+    if meta is None:
+        raise ValueError("model has no lm_meta — build it with "
+                         "TransformerLM(...) to generate")
+    prompt = jnp.asarray(prompt)
+    if prompt.shape[1] + n_new > meta["max_len"]:
+        raise ValueError(f"prompt {prompt.shape[1]} + new {n_new} exceeds "
+                         f"the model's max_len {meta['max_len']}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    # the compiled program bakes in the dtype policy at trace time — key
+    # the jit cache on it so set_policy() between calls retraces instead
+    # of silently reusing stale-dtype executables
+    policy_key = (str(activation_dtype()), str(compute_dtype()))
+    return _generate_impl(
+        params, prompt, rng, num_layers=meta["num_layers"],
+        num_heads=meta["num_heads"], max_len=meta["max_len"],
+        n_new=n_new, temperature=config.temperature, top_k=config.top_k,
+        policy_key=policy_key)
 
 
 def beam_search(model, prompt, *, num_beams: int = 4,

@@ -86,18 +86,48 @@ def _transformer_perf(args):
     vocab, s, b = args.classNum, args.seqLen, args.batchSize
     # logits head + lse-form CrossEntropy (the memory-lean recipe);
     # size-averaged loss and a sane lr keep the synthetic run finite
-    model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
+    model = TransformerLM(vocab, d_model=args.dModel,
+                          num_heads=args.dModel // 128,
+                          num_layers=args.numLayers,
                           max_len=s, with_log_softmax=False)
     model.materialize(jax.random.PRNGKey(0))
     model.training()
-    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
-                                       size_average=True)
+    # CrossEntropyCriterion flattens (B, S, V) itself; wrapping it in
+    # TimeDistributedCriterion is semantically identical (same mean) but
+    # the vmap-over-T made XLA materialize a TIME-MAJOR f32 transpose of
+    # the logits (2.15 GB at vocab 32k — round-3 trace, docs/PERF.md)
+    crit = nn.CrossEntropyCriterion()
     optim = SGD(learning_rate=0.01)
     params, mstate = model.params, model.state
     opt_state = optim.init_state(params)
 
+    # fused head+loss: run the body to hidden states and hand the lm_head
+    # weight to the chunked-vocab kernel — full (B, S, V) logits never
+    # materialize (ops/pallas/fused_ce.py; round-3 trace found ~10 ms of
+    # the 44.5 ms step in the three logits materializations at vocab 32k)
+    import jax as _jx
+    fused = (args.fusedHeadLoss != "off"
+             and _jx.default_backend() == "tpu")
+    head_idx = str(len(model.modules) - 1)   # lm_head Linear
+
     def step(params, mstate, opt_state, data, labels):
         def loss_fn(p):
+            if fused:
+                from bigdl_tpu.ops.pallas.fused_ce import \
+                    linear_cross_entropy
+                x, st = data, mstate
+                for i, m in enumerate(model.modules[:-1]):
+                    x, _ = m.apply(p[str(i)], mstate[str(i)], x,
+                                   training=True)
+                d_model = x.shape[-1]
+                # head weight rides the MXU in the activation dtype (the
+                # unfused Linear does the same via DTypePolicy); grads
+                # flow back to the f32 param through the cast's VJP
+                loss = linear_cross_entropy(
+                    x.reshape(-1, d_model),
+                    p[head_idx]["weight"].astype(x.dtype),
+                    p[head_idx].get("bias"), labels.reshape(-1))
+                return loss, mstate
             y, st = model.apply(p, mstate, data, training=True)
             return crit.apply(y, labels), st
         (loss, s2), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -132,11 +162,47 @@ def _transformer_perf(args):
     print(line)
 
 
+def _decode_perf(args):
+    """KV-cache decode throughput (the docs/PERF.md decode table):
+    27M LM, prompt 512, 128 new tokens, greedy."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                       generate)
+    from bigdl_tpu.tensor import DTypePolicy, set_policy
+
+    if args.dataType == "bf16":
+        set_policy(DTypePolicy(param_dtype=jnp.float32,
+                               compute_dtype=jnp.bfloat16,
+                               activation_dtype=jnp.bfloat16))
+    vocab, b = args.classNum, args.batchSize
+    p_len, n_new = 512, 128
+    model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
+                          max_len=p_len + n_new, with_log_softmax=False)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    host = np.random.default_rng(0)
+    prompt = jnp.asarray(host.integers(1, vocab + 1, size=(b, p_len)))
+    cfg = GenerationConfig(max_new_tokens=n_new)
+    out = generate(model, prompt, cfg)           # compile + warm
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(args.iteration):
+        out = generate(model, prompt, cfg)
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / args.iteration
+    print(f"decode: B{b} prompt {p_len} +{n_new} new: "
+          f"{b * n_new / dt:,.0f} tokens/s ({dt / n_new * 1e3:.2f} "
+          f"ms/step)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description="training perf harness")
     parser.add_argument("-m", "--module", default="inception_v1",
                         choices=sorted(MODELS) + ["attention",
-                                                  "transformer"])
+                                                  "transformer", "decode"])
     parser.add_argument("-b", "--batchSize", type=int, default=None,
                         help="default: 128 (conv models), 4 (attention), "
                              "8 (transformer)")
@@ -155,19 +221,32 @@ def main(argv=None):
                         help="attention mode: heads")
     parser.add_argument("--headDim", type=int, default=128,
                         help="attention mode: head dim")
+    parser.add_argument("--fusedHeadLoss", default="auto",
+                        choices=["auto", "off"],
+                        help="transformer mode: chunked-vocab fused "
+                             "head+CE kernel (auto: on TPU)")
+    parser.add_argument("--dModel", type=int, default=512,
+                        help="transformer mode: model width (heads = "
+                             "dModel/128)")
+    parser.add_argument("--numLayers", type=int, default=6,
+                        help="transformer mode: layers")
     args = parser.parse_args(argv)
 
     if args.batchSize is None:
-        args.batchSize = {"attention": 4, "transformer": 8}.get(
+        args.batchSize = {"attention": 4, "transformer": 8,
+                          "decode": 64}.get(
             args.module, 128)
     if args.seqLen is None:
         args.seqLen = 2048 if args.module == "transformer" else 4096
     if args.classNum is None:
-        args.classNum = 8192 if args.module == "transformer" else 1000
+        args.classNum = (8192 if args.module in ("transformer", "decode")
+                         else 1000)
     if args.module == "attention":
         return _attention_perf(args)
     if args.module == "transformer":
         return _transformer_perf(args)
+    if args.module == "decode":
+        return _decode_perf(args)
 
     import jax
     import jax.numpy as jnp

@@ -51,8 +51,18 @@ __all__ = ["lrn", "lrn_supported"]
 # spatial rows per program. Swept in-model on v5e batch 256 (round 3):
 # shift-form kernel HT 2/4/8 -> 4627/4754/4633 img/s; band-matmul kernel
 # HT 4/8 -> 4920/4993 img/s, HT>=16 fails to compile (f32 temps exceed
-# VMEM at C=192, N=256).
+# VMEM at C=192, N=256). ``_pick_hw_tile`` scales the tile DOWN with
+# C*N so bigger batches stay inside the same ~6 MB f32-temp budget the
+# HT=8/C=192/N=256 winner used, instead of VMEM-crashing.
 _HW_TILE = 8
+_TEMP_BUDGET = 8 * 192 * 256 * 4    # bytes per f32 temp at the swept max
+
+
+def _pick_hw_tile(c: int, n: int) -> int:
+    ht = _HW_TILE
+    while ht > 1 and ht * c * n * 4 > _TEMP_BUDGET:
+        ht //= 2
+    return ht
 
 
 def _sublane(dtype) -> int:
@@ -60,9 +70,12 @@ def _sublane(dtype) -> int:
 
 
 def lrn_supported(x) -> bool:
-    """Kernel constraints: TPU backend, NCHW with C a full sublane tile."""
+    """Kernel constraints: TPU backend, NCHW with C a full sublane tile,
+    and a batch that fills the lane axis (the (H*W, C, N) view puts N on
+    lanes — below ~half a lane tile the XLA fallback path wins)."""
     return (jax.default_backend() == "tpu" and x.ndim == 4
-            and x.shape[1] % _sublane(x.dtype) == 0)
+            and x.shape[1] % _sublane(x.dtype) == 0
+            and x.shape[0] >= 64)
 
 
 def _band_matrix(c, size, adjoint=False):
@@ -115,8 +128,9 @@ def _bwd_kernel(g_ref, x_ref, band_ref, adj_ref, dx_ref, *,
 
 
 def _call(kernel, args, bands, hw, c, n, dtype, interpret):
-    grid = (pl.cdiv(hw, _HW_TILE),)
-    spec = pl.BlockSpec((_HW_TILE, c, n), lambda t: (t, 0, 0))
+    ht = _pick_hw_tile(c, n)
+    grid = (pl.cdiv(hw, ht),)
+    spec = pl.BlockSpec((ht, c, n), lambda t: (t, 0, 0))
     band_spec = pl.BlockSpec((c, c), lambda t: (0, 0))
     return pl.pallas_call(
         kernel,

@@ -51,15 +51,30 @@ _WIRE_FACTOR = {
 }
 
 
-def _shape_bytes(shape_str: str) -> int:
-    total = 0
+def _element_bytes(shape_str: str) -> list[int]:
+    out = []
     for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_str):
         n = 1
         for d in m.group(2).split(","):
             if d:
                 n *= int(d)
-        total += n * _DT_BYTES.get(m.group(1), 4)
-    return total
+        out.append(n * _DT_BYTES.get(m.group(1), 4))
+    return out
+
+
+def _payload_bytes(shape_str: str, async_start: bool) -> int:
+    """Collective payload from an instruction's result shape.
+
+    Async ``-start`` ops carry a tuple of (operand(s), result, ...);
+    summing it double-counts the payload (all-reduce-start holds two
+    full-size copies). The LARGEST element is the right basis for every
+    kind: all-reduce operand==result, all-gather's output and
+    reduce-scatter's input are the wire-formula operands and are the
+    biggest members."""
+    elems = _element_bytes(shape_str)
+    if not elems:
+        return 0
+    return max(elems) if async_start else sum(elems)
 
 
 def _group_size(line: str, default: int) -> int:
@@ -95,7 +110,7 @@ def collective_bytes(hlo_text: str, n_devices: int) -> dict:
         base = op.removesuffix("-start")
         if base not in _COLLECTIVES or op.endswith("-done"):
             continue
-        b = _shape_bytes(m.group(1))
+        b = _payload_bytes(m.group(1), op.endswith("-start"))
         n = max(_group_size(ls, n_devices), 1)
         ops += 1
         logical += b

@@ -259,8 +259,24 @@ ENTRY %main {
         assert acct["ops"] == 2
         ar_bytes = 1024 * 8 * 4
         assert acct["by_kind"]["all-reduce"] == [1, ar_bytes]
+        # the async all-gather-start tuple holds (operand, result); only
+        # the gathered result (the largest element) is payload
+        assert acct["by_kind"]["all-gather"] == [1, 1024 * 4]
         assert acct["wire_bytes_per_chip"] == pytest.approx(
-            ar_bytes * 2 * 3 / 4 + (256 * 4 + 1024 * 4) * 3 / 4)
+            ar_bytes * 2 * 3 / 4 + 1024 * 4 * 3 / 4)
+
+    def test_async_allreduce_start_not_double_counted(self):
+        from bigdl_tpu.parallel.collective_bench import collective_bytes
+        hlo = """
+ENTRY %main {
+  %ar-start = (f32[1000]{0}, f32[1000]{0}) all-reduce-start(%p), replica_groups={{0,1}}, to_apply=%add
+  %ar-done = f32[1000]{0} all-reduce-done(%ar-start)
+}
+"""
+        acct = collective_bytes(hlo, 2)
+        assert acct["ops"] == 1
+        assert acct["logical_bytes"] == 4000       # not 8000
+        assert acct["wire_bytes_per_chip"] == pytest.approx(4000.0)
 
 
 def test_distri_partial_final_batch_recompiles():

@@ -1,0 +1,85 @@
+"""Fused LM-head cross-entropy kernel (ops/pallas/fused_ce.py) —
+interpret-mode parity with the materialized-logits XLA path, values AND
+gradients, plus torch golden values for the loss itself."""
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.pallas.fused_ce import linear_cross_entropy
+
+
+def _case(n=256, d=128, v=512, dtype=jnp.float32, seed=0):
+    rs = np.random.default_rng(seed)
+    h = jnp.asarray(0.5 * rs.standard_normal((n, d)), dtype)
+    w = jnp.asarray(0.5 * rs.standard_normal((v, d)) / np.sqrt(d), dtype)
+    b = jnp.asarray(0.1 * rs.standard_normal((v,)), dtype)
+    t = jnp.asarray(rs.integers(1, v + 1, size=(n,)))
+    return h, w, b, t
+
+
+class TestParity:
+    @pytest.mark.parametrize("reduction", ["mean", "sum"])
+    def test_forward_matches_xla_path(self, reduction):
+        h, w, b, t = _case()
+        got = linear_cross_entropy(h, w, b, t, reduction=reduction,
+                                   use_kernel=True, interpret=True)
+        want = linear_cross_entropy(h, w, b, t, reduction=reduction,
+                                    use_kernel=False)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_gradients_match_xla_path(self):
+        h, w, b, t = _case()
+
+        def kernel_loss(h, w, b):
+            return linear_cross_entropy(h, w, b, t, use_kernel=True,
+                                        interpret=True)
+
+        def xla_loss(h, w, b):
+            return linear_cross_entropy(h, w, b, t, use_kernel=False)
+
+        gk = jax.grad(kernel_loss, argnums=(0, 1, 2))(h, w, b)
+        gx = jax.grad(xla_loss, argnums=(0, 1, 2))(h, w, b)
+        for a, e, name in zip(gk, gx, "h w b".split()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-5, atol=1e-6,
+                                       err_msg=f"d{name}")
+
+    def test_matches_torch_cross_entropy(self):
+        """Golden values: torch F.cross_entropy on the same logits
+        (targets converted to 0-based for torch)."""
+        h, w, b, t = _case(n=128, d=128, v=256, seed=3)
+        got = float(linear_cross_entropy(h, w, b, t, use_kernel=True,
+                                         interpret=True))
+        logits = torch.tensor(np.asarray(h) @ np.asarray(w).T
+                              + np.asarray(b))
+        want = torch.nn.functional.cross_entropy(
+            logits, torch.tensor(np.asarray(t) - 1).long()).item()
+        assert abs(got - want) < 1e-4 * max(1.0, abs(want))
+
+    def test_no_bias(self):
+        h, w, _, t = _case()
+        got = linear_cross_entropy(h, w, None, t, use_kernel=True,
+                                   interpret=True)
+        want = linear_cross_entropy(h, w, None, t, use_kernel=False)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_bf16_storage(self):
+        h, w, b, t = _case(dtype=jnp.bfloat16, seed=5)
+        got = float(linear_cross_entropy(h, w, b, t, use_kernel=True,
+                                         interpret=True))
+        want = float(linear_cross_entropy(h, w, b, t, use_kernel=False))
+        assert abs(got - want) < 3e-3 * max(1.0, abs(want))
+
+    def test_force_kernel_on_bad_shapes_raises(self):
+        h, w, b, t = _case(n=200)   # 200 % 128 != 0
+        with pytest.raises(ValueError, match="fused CE kernel"):
+            linear_cross_entropy(h, w, b, t, use_kernel=True)
+
+    def test_auto_falls_back_off_tpu(self):
+        h, w, b, t = _case()
+        got = linear_cross_entropy(h, w, b, t)   # auto on CPU -> XLA path
+        want = linear_cross_entropy(h, w, b, t, use_kernel=False)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-7)
