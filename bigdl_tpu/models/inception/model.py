@@ -80,9 +80,18 @@ def _v1_stem():
                                     propagate_back=False,
                                     init_method=init_mod.Xavier)
                  .set_name("conv1/7x7_s2"))
-            .add(ReLU().set_name("conv1/relu_7x7"))
+            # ReLU AFTER the stride-2 pool: relu(maxpool(x)) ==
+            # maxpool(relu(x)) exactly (max commutes with any monotone
+            # map), and the elementwise pass runs on 56x56 instead of
+            # 112x112 — 4x less traffic on the model's biggest
+            # activation. The reference order (Inception_v1.scala:100) is
+            # relu-then-pool; outputs and gradients are identical.
             .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
-            .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+            # ...which lands the ReLU next to norm1: one fused HBM pass
+            .add(ReLUCrossMapLRN(
+                ReLU().set_name("conv1/relu_7x7"),
+                SpatialCrossMapLRN(5, 0.0001, 0.75)
+                .set_name("pool1/norm1")))
             .add(SpatialConvolution(64, 64, 1, 1, 1, 1,
                                     init_method=init_mod.Xavier)
                  .set_name("conv2/3x3_reduce"))

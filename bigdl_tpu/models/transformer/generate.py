@@ -166,28 +166,23 @@ def _prefill(params, prompt, num_layers, num_heads, max_len):
     return tuple(ck), tuple(cv), x, pos0
 
 
-def _setup_and_prefill(model, prompt, n_new, params):
-    """Shared decode preamble: meta checks, cache allocation, and the
-    prompt prefill pass. Returns (params, meta dims, caches, last-layer
-    activations, pos0)."""
+def _decode_setup(model, prompt, n_new, params):
+    """Shared eager preamble for generate/beam_search: meta + length
+    validation and the dtype-policy jit-cache key (the compiled program
+    bakes in the policy at trace time — keying on it makes set_policy()
+    between calls retrace instead of silently reusing stale-dtype
+    executables)."""
     params = model.params if params is None else params
     meta = getattr(model, "lm_meta", None)
     if meta is None:
         raise ValueError("model has no lm_meta — build it with "
                          "TransformerLM(...) to generate")
-    num_layers, num_heads, max_len = (meta["num_layers"],
-                                      meta["num_heads"], meta["max_len"])
     prompt = jnp.asarray(prompt)
-    b, p_len = prompt.shape
-    if p_len + n_new > max_len:
-        raise ValueError(f"prompt {p_len} + new {n_new} exceeds the "
-                         f"model's max_len {max_len}")
-    embed, blocks, _, _ = _model_parts(params, num_layers)
-    dtype = activation_dtype()
-    ck, cv, x, pos0 = _prefill(params, prompt, num_layers, num_heads,
-                               max_len)
-    return (params, prompt, num_layers, num_heads, max_len, embed,
-            blocks, dtype, ck, cv, x, pos0)
+    if prompt.shape[1] + n_new > meta["max_len"]:
+        raise ValueError(f"prompt {prompt.shape[1]} + new {n_new} exceeds "
+                         f"the model's max_len {meta['max_len']}")
+    policy_key = (str(activation_dtype()), str(compute_dtype()))
+    return params, prompt, meta, policy_key
 
 
 def _sample(logits, key, temperature, top_k):
@@ -253,21 +248,10 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
     """
     config = config or GenerationConfig()
     n_new = config.max_new_tokens
-    params = model.params if params is None else params
-    meta = getattr(model, "lm_meta", None)
-    if meta is None:
-        raise ValueError("model has no lm_meta — build it with "
-                         "TransformerLM(...) to generate")
-    prompt = jnp.asarray(prompt)
-    if prompt.shape[1] + n_new > meta["max_len"]:
-        raise ValueError(f"prompt {prompt.shape[1]} + new {n_new} exceeds "
-                         f"the model's max_len {meta['max_len']}")
+    params, prompt, meta, policy_key = _decode_setup(model, prompt,
+                                                     n_new, params)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    # the compiled program bakes in the dtype policy at trace time — key
-    # the jit cache on it so set_policy() between calls retraces instead
-    # of silently reusing stale-dtype executables
-    policy_key = (str(activation_dtype()), str(compute_dtype()))
     return _generate_impl(
         params, prompt, rng, num_layers=meta["num_layers"],
         num_heads=meta["num_heads"], max_len=meta["max_len"],
@@ -288,13 +272,29 @@ def beam_search(model, prompt, *, num_beams: int = 4,
 
     Beams fold into the batch dim (B*K rows) so every step is the same
     single-token cache step as ``generate``; each step's top-k reorders
-    beam histories AND cache rows with one gather.
+    beam histories AND cache rows with one gather. Like ``generate``,
+    the whole program is one module-level jitted function — repeated
+    calls with the same shapes and knobs reuse the compiled executable.
     """
-    k = num_beams
-    n_new = max_new_tokens
-    (params, prompt, num_layers, num_heads, max_len, embed, blocks,
-     dtype, ck, cv, x, pos0) = _setup_and_prefill(model, prompt, n_new,
-                                                  params)
+    params, prompt, meta, policy_key = _decode_setup(
+        model, prompt, max_new_tokens, params)
+    return _beam_search_impl(
+        params, prompt, num_layers=meta["num_layers"],
+        num_heads=meta["num_heads"], max_len=meta["max_len"],
+        n_new=max_new_tokens, k=num_beams,
+        length_penalty=length_penalty, eos_id=eos_id,
+        policy_key=policy_key)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_layers", "num_heads", "max_len", "n_new", "k",
+    "length_penalty", "eos_id", "policy_key"))
+def _beam_search_impl(params, prompt, *, num_layers, num_heads, max_len,
+                      n_new, k, length_penalty, eos_id, policy_key):
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    dtype = activation_dtype()
+    ck, cv, x, pos0 = _prefill(params, prompt, num_layers, num_heads,
+                               max_len)
     b = prompt.shape[0]
     logp0 = jax.nn.log_softmax(
         _logits(params, num_layers, x).astype(jnp.float32), axis=-1)

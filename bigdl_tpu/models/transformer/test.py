@@ -29,8 +29,14 @@ def main(argv=None):
         args.folder, args.vocabSize, args.seqLength, args.batchSize,
         one_hot=False)
     model = bfile.load_module(args.model)
-    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                            size_average=True)
+    # snapshots may end at log-probs (with_log_softmax=True) or raw
+    # logits (the train main's memory-lean recipe) — same mean loss
+    # either way; both criterions flatten (B, S, V) themselves, no
+    # TimeDistributed vmap needed (docs/PERF.md round 3)
+    if isinstance(model.modules[-1], nn.LogSoftMax):
+        criterion = nn.ClassNLLCriterion()
+    else:
+        criterion = nn.CrossEntropyCriterion()
     validator = LocalValidator(model, val_set)
     results = validator.test([Loss(criterion)])
     for result, method in results:

@@ -46,15 +46,20 @@ def main(argv=None):
         args.folder, args.vocabSize, args.seqLength, batch,
         one_hot=False, dictionary_dir=args.checkpoint)
 
+    # raw-logits head + flat CrossEntropy — the memory-lean LM recipe
+    # (docs/PERF.md): no (B, S, V) f32 log-prob residual, and no
+    # TimeDistributed vmap (CrossEntropyCriterion flattens (B, S, V)
+    # itself; the vmap-over-T variant materialized a time-major f32
+    # transpose of the logits)
     model = (bfile.load_module(args.model) if args.model
              else TransformerLM(vocab, d_model=args.dModel,
                                 num_heads=args.numHeads,
                                 num_layers=args.numLayers,
                                 max_len=args.seqLength,
                                 dropout=args.dropout,
-                                sequence_parallel=args.sequenceParallel))
-    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                            size_average=True)
+                                sequence_parallel=args.sequenceParallel,
+                                with_log_softmax=False))
+    criterion = nn.CrossEntropyCriterion()
     optimizer = Optimizer(model, train_set, criterion, mesh=mesh)
     optimizer.set_optim_method(SGD(
         learning_rate=args.learningRate or 0.02,
