@@ -59,6 +59,20 @@ def main(argv=None):
                                 dropout=args.dropout,
                                 sequence_parallel=args.sequenceParallel,
                                 with_log_softmax=False))
+    if isinstance(model.modules[-1], nn.LogSoftMax):
+        # legacy snapshot with a log-softmax head: CE(log_softmax(x)) ==
+        # CE(x) exactly (logsumexp of log-probs is 0), but keeping the
+        # layer would materialize the (B, S, V) f32 log-prob tensor the
+        # lean recipe exists to avoid — strip it (parameter-free)
+        import logging
+        logging.getLogger("bigdl_tpu").info(
+            "stripping LogSoftMax head from loaded snapshot "
+            "(raw-logits + CrossEntropy training recipe)")
+        idx = str(len(model.modules) - 1)
+        model.modules.pop()
+        for tree in (model.params, model.state, model.grad_params):
+            if isinstance(tree, dict):
+                tree.pop(idx, None)
     criterion = nn.CrossEntropyCriterion()
     optimizer = Optimizer(model, train_set, criterion, mesh=mesh)
     optimizer.set_optim_method(SGD(

@@ -32,6 +32,7 @@ class BatchNormalization(Module):
     """1-D batch norm over (N, C) (reference nn/BatchNormalization.scala)."""
 
     n_dim = 2
+    _one_pass_stats = False   # exact two-pass variance (see apply)
 
     def __init__(self, n_output: int, eps: float = 1e-5,
                  momentum: float = 0.1, affine: bool = True,
@@ -68,12 +69,35 @@ class BatchNormalization(Module):
         if training:
             xs = x.astype(stat_dtype)
             mean = jnp.mean(xs, axis=axes)
-            var = jnp.var(xs, axis=axes)
-            if self.axis_name is not None:
-                mean = jax.lax.pmean(mean, self.axis_name)
-                var = jax.lax.pmean(var, self.axis_name)
+            if self._one_pass_stats:
+                # one fused pass: E[x] and E[x^2] reduce together, where
+                # jnp.var's (x - mean)^2 form needs a SECOND sequential
+                # read of the activation after the mean lands — profiled
+                # at 33% of a ResNet-50 step (98 convert_reduce fusions,
+                # 18.8 ms; docs/PERF.md round 3). Spatial variant only:
+                # conv outputs are near-zero-mean, so the f32
+                # cancellation the two-pass form guards against is
+                # absent; the generic (N, C) module keeps the exact form
+                # (raw feature columns can have mean/std ratios where
+                # E[x^2]-E[x]^2 rounds to zero).
+                mean2 = jnp.mean(jnp.square(xs), axis=axes)
+                if self.axis_name is not None:
+                    # pmean of per-device moments is EXACT for E[x]/E[x^2]
+                    # (it was only approximate for per-device variances)
+                    mean = jax.lax.pmean(mean, self.axis_name)
+                    mean2 = jax.lax.pmean(mean2, self.axis_name)
+                var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            else:
+                var = jnp.var(xs, axis=axes)
+                if self.axis_name is not None:
+                    mean = jax.lax.pmean(mean, self.axis_name)
+                    var = jax.lax.pmean(var, self.axis_name)
             n = np.prod([x.shape[a] for a in axes])
-            unbiased = var * n / max(n - 1, 1)
+            if self.axis_name is not None and self._one_pass_stats:
+                # the fused form's variance is GLOBAL over all devices'
+                # samples; Bessel must use the global count too
+                n = n * jax.lax.psum(1, self.axis_name)
+            unbiased = var * n / jnp.maximum(n - 1, 1)
             m = self.momentum
             new_state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,
@@ -107,6 +131,7 @@ class SpatialBatchNormalization(BatchNormalization):
     nn/SpatialBatchNormalization.scala)."""
 
     n_dim = 4
+    _one_pass_stats = True    # fused E[x]/E[x^2] over conv activations
 
 
 def _lrn_window_sum(v, size, adjoint=False):

@@ -292,3 +292,25 @@ def test_distri_partial_final_batch_recompiles():
     assert trained is model
     assert np.isfinite(
         np.asarray(model.forward(np.zeros((4, 2), np.float32)))).all()
+
+
+def test_spatial_bn_cross_device_unbiased_running_var():
+    """Round-3: the fused-moment spatial BN computes the GLOBAL variance
+    across the mesh, so Bessel must use the global sample count."""
+    from jax.sharding import PartitionSpec as P
+    mesh = Engine.init(axes={"data": 8})
+    sbn = nn.SpatialBatchNormalization(3, axis_name="data")
+    sbn.materialize(jax.random.PRNGKey(0))
+    xg = np.random.default_rng(1).standard_normal(
+        (16, 3, 4, 4)).astype(np.float32)
+
+    def body(xs):
+        _, st = sbn.apply(sbn.params, sbn.state, xs, training=True)
+        return st["running_var"]
+
+    from jax.experimental.shard_map import shard_map
+    with mesh:
+        rv = shard_map(body, mesh=mesh, in_specs=P("data"),
+                       out_specs=P())(jnp.asarray(xg))
+    want = 0.9 + 0.1 * np.var(xg, axis=(0, 2, 3), ddof=1)
+    np.testing.assert_allclose(np.asarray(rv), want, rtol=1e-4)
