@@ -474,3 +474,62 @@ def test_clipping_rejects_bad_args():
         opt.set_gradient_clipping()
     with _pytest.raises(ValueError, match="must be <"):
         opt.set_gradient_clipping(min_value=0.1, max_value=-0.1)
+
+
+class TestSGDGroupedUpdate:
+    """Round-3 small-leaf grouping (optim/sgd.py _grouped_update): many
+    tiny f32 leaves update on one concatenated vector. Must be
+    elementwise-identical to the per-leaf form."""
+
+    def _tree(self, n_small=20, seed=0):
+        rs = np.random.RandomState(seed)
+        t = {f"bn{i}": jnp.asarray(rs.rand(8).astype(np.float32))
+             for i in range(n_small)}
+        t["conv_w"] = jnp.asarray(rs.rand(64, 3, 3, 3).astype(np.float32))
+        t["big"] = jnp.asarray(rs.rand(200000).astype(np.float32))
+        return t
+
+    @pytest.mark.parametrize("momentum", [0.0, 0.9])
+    def test_grouped_matches_per_leaf(self, momentum):
+        from bigdl_tpu.optim import SGD
+        params = self._tree()
+        grads = jax.tree.map(lambda p: 0.1 * p + 0.01, params)
+        sgd = SGD(learning_rate=0.05, momentum=momentum,
+                  weight_decay=1e-4, nesterov=False)
+        st = sgd.init_state(params)
+        p1, s1 = sgd.update(grads, params, st)        # grouped engages
+        try:
+            SGD._SMALL_LEAF = 0                        # force per-leaf
+            p2, s2 = sgd.update(grads, params, st)
+        finally:
+            SGD._SMALL_LEAF = 16384
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), p1, p2)
+        if momentum > 0:
+            jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+                s1["velocity"], s2["velocity"])
+
+    def test_structure_mismatch_raises(self):
+        from bigdl_tpu.optim import SGD
+        params = self._tree()
+        grads = dict(jax.tree.map(lambda p: p, params))
+        grads["renamed"] = grads.pop("bn0")
+        sgd = SGD(learning_rate=0.05)
+        with pytest.raises((ValueError, TypeError)):
+            sgd.update(grads, params, sgd.init_state(params))
+
+    def test_per_param_learning_rates_and_decays(self):
+        """reference SGD.scala learningRates/weightDecays, tree-shaped:
+        a zero lr-scale freezes a leaf; per-leaf wd applies."""
+        from bigdl_tpu.optim import SGD
+        params = {"a": jnp.ones(4), "b": jnp.ones(4)}
+        grads = {"a": jnp.full(4, 0.5), "b": jnp.full(4, 0.5)}
+        sgd = SGD(learning_rate=0.1,
+                  learning_rates={"a": 0.0, "b": 1.0},
+                  weight_decays={"a": 0.0, "b": 0.1})
+        p, _ = sgd.update(grads, params, sgd.init_state(params))
+        np.testing.assert_array_equal(np.asarray(p["a"]), np.ones(4))
+        # b: g = 0.5 + 0.1*1 = 0.6; p = 1 - 0.1*0.6 = 0.94
+        np.testing.assert_allclose(np.asarray(p["b"]),
+                                   np.full(4, 0.94), rtol=1e-6)
