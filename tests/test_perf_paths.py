@@ -191,3 +191,45 @@ def test_batchnorm_stats_f32_under_bf16_activations():
     assert y.dtype == jnp.bfloat16
     assert new_state["running_mean"].dtype == jnp.float32
     assert new_state["running_var"].dtype == jnp.float32
+
+
+def test_pallas_lrn_fused_relu_matches_composition():
+    """lrn(x, relu=True) must equal lrn(relu(x)) in values AND in the
+    gradient wrt the PRE-relu input (round-3 ReLUCrossMapLRN fusion)."""
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(64, 16, 4, 4).astype(np.float32))
+    args = (5, 1e-4, 0.75, 1.0)
+    interp = jax.default_backend() != "tpu"   # compile for real on TPU
+    y_fused = plrn.lrn(x, *args, interp, True)
+    y_comp = plrn.lrn(jax.nn.relu(x), *args, interp)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_comp),
+                               rtol=1e-6, atol=1e-7)
+    g_fused = jax.grad(lambda v: jnp.sum(
+        plrn.lrn(v, *args, interp, True) ** 2))(x)
+    g_comp = jax.grad(lambda v: jnp.sum(
+        _lrn_impl(jax.nn.relu(v), *args) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_comp),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_relu_crossmap_lrn_module_matches_children():
+    """nn.ReLUCrossMapLRN forward/backward == ReLU;LRN run in sequence
+    (the CPU fallback path; the TPU kernel path is pinned by the test
+    above plus the inception golden fixture)."""
+    from bigdl_tpu import nn
+    rs = np.random.RandomState(8)
+    x = rs.randn(4, 16, 5, 5).astype(np.float32)
+    fused = nn.ReLUCrossMapLRN(nn.ReLU(), nn.SpatialCrossMapLRN(5, 1e-4,
+                                                                0.75))
+    ref = nn.Sequential(nn.ReLU(), nn.SpatialCrossMapLRN(5, 1e-4, 0.75))
+    fused.materialize(jax.random.PRNGKey(0))
+    ref.materialize(jax.random.PRNGKey(0))
+    y_f = fused.forward(x)
+    y_r = ref.forward(x)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_r),
+                               rtol=1e-6)
+    g = np.ones_like(np.asarray(y_f))
+    gx_f = fused.backward(x, g)
+    gx_r = ref.backward(x, g)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_r),
+                               rtol=1e-5, atol=1e-7)
