@@ -247,33 +247,38 @@ class TestCollectiveAccounting:
 
     def test_collective_bytes_parser(self):
         from bigdl_tpu.parallel.collective_bench import collective_bytes
-        hlo = """
-ENTRY %main {
-  %p0 = f32[1024,8]{1,0} parameter(0)
-  %ar = f32[1024,8]{1,0} all-reduce(%p0), replica_groups={{0,1,2,3}}, to_apply=%add
-  %ag-start = (f32[256]{0}, f32[1024]{0}) all-gather-start(%x), replica_groups=[1,4]<=[4], dimensions={0}
-  %ag-done = f32[1024]{0} all-gather-done(%ag-start)
-}
-"""
+        # realistic single-line HLO instruction forms (XLA prints one
+        # instruction per line); shapes kept small to stay readable
+        hlo = "\n".join([
+            "ENTRY %main {",
+            "  %p0 = f32[1024,8]{1,0} parameter(0)",
+            "  %ar = f32[1024,8]{1,0} all-reduce(%p0),"
+            " replica_groups={{0,1,2,3}}, to_apply=%add",
+            "  %g = (f32[8]{0}, f32[32]{0}) all-gather-start(%x),"
+            " replica_groups=[1,4]<=[4], dimensions={0}",
+            "  %gd = f32[32]{0} all-gather-done(%g)",
+            "}",
+        ])
         acct = collective_bytes(hlo, 4)
         assert acct["ops"] == 2
         ar_bytes = 1024 * 8 * 4
         assert acct["by_kind"]["all-reduce"] == [1, ar_bytes]
         # the async all-gather-start tuple holds (operand, result); only
         # the gathered result (the largest element) is payload
-        assert acct["by_kind"]["all-gather"] == [1, 1024 * 4]
+        assert acct["by_kind"]["all-gather"] == [1, 32 * 4]
         assert acct["wire_bytes_per_chip"] == pytest.approx(
-            ar_bytes * 2 * 3 / 4 + 1024 * 4 * 3 / 4)
+            ar_bytes * 2 * 3 / 4 + 32 * 4 * 3 / 4)
 
     def test_async_allreduce_start_not_double_counted(self):
         from bigdl_tpu.parallel.collective_bench import collective_bytes
-        hlo = """
-ENTRY %main {
-  %ar-start = (f32[1000]{0}, f32[1000]{0}) all-reduce-start(%p), replica_groups={{0,1}}, to_apply=%add
-  %ar-done = f32[1000]{0} all-reduce-done(%ar-start)
-}
-"""
-        acct = collective_bytes(hlo, 2)
+        hlo = "\n".join([
+            "ENTRY %main {",
+            "  %s = (f32[1000]{0}, f32[1000]{0}) all-reduce-start(%p),"
+            " replica_groups={{0,1}}, to_apply=%add",
+            "  %d = f32[1000]{0} all-reduce-done(%s)",
+            "}",
+        ])
+        acct = collective_bytes(hlo, 99)   # default must NOT be used
         assert acct["ops"] == 1
         assert acct["logical_bytes"] == 4000       # not 8000
         assert acct["wire_bytes_per_chip"] == pytest.approx(4000.0)
