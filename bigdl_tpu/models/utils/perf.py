@@ -115,10 +115,10 @@ def _transformer_perf(args):
             if fused:
                 from bigdl_tpu.ops.pallas.fused_ce import \
                     linear_cross_entropy
-                x, st = data, mstate
+                x, new_mstate = data, dict(mstate)
                 for i, m in enumerate(model.modules[:-1]):
-                    x, _ = m.apply(p[str(i)], mstate[str(i)], x,
-                                   training=True)
+                    x, new_mstate[str(i)] = m.apply(
+                        p[str(i)], mstate[str(i)], x, training=True)
                 d_model = x.shape[-1]
                 # head weight rides the MXU in the activation dtype (the
                 # unfused Linear does the same via DTypePolicy); grads
@@ -127,7 +127,7 @@ def _transformer_perf(args):
                     x.reshape(-1, d_model),
                     p[head_idx]["weight"].astype(x.dtype),
                     p[head_idx].get("bias"), labels.reshape(-1))
-                return loss, mstate
+                return loss, new_mstate
             y, st = model.apply(p, mstate, data, training=True)
             return crit.apply(y, labels), st
         (loss, s2), g = jax.value_and_grad(loss_fn, has_aux=True)(params)

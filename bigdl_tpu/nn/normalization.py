@@ -128,10 +128,19 @@ class BatchNormalization(Module):
 
 class SpatialBatchNormalization(BatchNormalization):
     """4-D (N, C, H, W) wrapper (reference
-    nn/SpatialBatchNormalization.scala)."""
+    nn/SpatialBatchNormalization.scala).
+
+    ``one_pass_stats=True`` (default) fuses E[x]/E[x^2] into one
+    activation read — right for near-zero-mean conv outputs. A stem BN
+    fed raw, non-centered inputs can lose precision to E[x^2]-E[x]^2
+    cancellation in f32; pass ``one_pass_stats=False`` there to get the
+    exact two-pass variance of the base class."""
 
     n_dim = 4
-    _one_pass_stats = True    # fused E[x]/E[x^2] over conv activations
+
+    def __init__(self, *args, one_pass_stats: bool = True, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._one_pass_stats = one_pass_stats
 
 
 def _lrn_window_sum(v, size, adjoint=False):

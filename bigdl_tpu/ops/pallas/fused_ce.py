@@ -233,6 +233,12 @@ def linear_cross_entropy(h, w, b, targets, *, reduction: str = "mean",
     (``linear_ce_supported``); True forces it (raises otherwise); False
     uses the XLA fallback (identical math, materialized logits).
     Returns the scalar mean (or summed) negative log-likelihood.
+
+    Contract: targets must lie in ``[1, V]`` (1-based, reference
+    ClassNLLCriterion convention). An out-of-contract target — e.g. a
+    0 padding label — contributes ``nll = lse`` (its one-hot matches no
+    class) on BOTH paths; mask padding out before calling if that is
+    not the intent.
     """
     n = h.shape[0]
     bias = b if b is not None else jnp.zeros((w.shape[0],), h.dtype)
@@ -250,8 +256,12 @@ def linear_cross_entropy(h, w, b, targets, *, reduction: str = "mean",
     else:
         logits = (h @ w.T.astype(h.dtype)).astype(jnp.float32) + bias
         lse = jax.nn.logsumexp(logits, axis=-1)
+        t0 = targets.astype(jnp.int32) - 1
         tl = jnp.take_along_axis(
-            logits, (targets.astype(jnp.int32) - 1)[:, None], axis=-1)[:, 0]
-        nll = lse - tl
+            logits, jnp.clip(t0, 0, w.shape[0] - 1)[:, None], axis=-1)[:, 0]
+        # out-of-contract targets match no class — same as the kernel's
+        # one-hot semantics (instead of take_along_axis index wrap-around)
+        in_contract = (t0 >= 0) & (t0 < w.shape[0])
+        nll = lse - jnp.where(in_contract, tl, 0.0)
     total = jnp.sum(nll)
     return total / n if reduction == "mean" else total

@@ -276,8 +276,15 @@ class SGD(OptimMethod):
         def hyper_leaves(spec):
             if spec is None:
                 return [None] * len(leaves_p)
-            if jax.tree.structure(spec) == treedef:
+            spec_def = jax.tree.structure(spec)
+            if spec_def == treedef:
                 return jax.tree.leaves(spec)
+            if spec_def != jax.tree.structure(0):   # not a true leaf
+                # a partially-specified / misspelled tree would otherwise
+                # broadcast as if it were a scalar and fail far away
+                raise ValueError(
+                    "SGD: per-parameter hyper tree does not match params "
+                    f"structure — params {treedef}, got {spec_def}")
             return [spec] * len(leaves_p)      # scalar broadcast
 
         leaves_g = self._matched_leaves(grads, treedef)

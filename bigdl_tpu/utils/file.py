@@ -96,8 +96,13 @@ def _open_write_atomic(path: str):
         return
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(tmp, "wb") as f:
-        yield f
+    try:
+        with open(tmp, "wb") as f:
+            yield f
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
     os.replace(tmp, path)
 
 

@@ -83,3 +83,35 @@ class TestParity:
         got = linear_cross_entropy(h, w, b, t)   # auto on CPU -> XLA path
         want = linear_cross_entropy(h, w, b, t, use_kernel=False)
         np.testing.assert_allclose(float(got), float(want), rtol=1e-7)
+
+
+class TestOutOfContractTargets:
+    """Targets outside [1, V] (e.g. 0 padding labels) contribute
+    nll = lse on BOTH paths — the kernel's one-hot matches no class and
+    the fallback masks instead of letting take_along_axis wrap."""
+
+    @pytest.mark.parametrize("bad", [0, 600])    # below 1 / above V=512
+    def test_fallback_matches_kernel_out_of_contract(self, bad):
+        h, w, b, _ = _case()
+        t = jnp.full((h.shape[0],), bad, jnp.int32)    # all padding
+        got = float(linear_cross_entropy(h, w, b, t, use_kernel=False))
+        kern = float(linear_cross_entropy(h, w, b, t, use_kernel=True,
+                                          interpret=True))
+        logits = np.asarray(h @ w.T + b, np.float64)
+        lse = np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                     .sum(-1)) + logits.max(-1)
+        np.testing.assert_allclose(got, lse.mean(), rtol=1e-5)
+        np.testing.assert_allclose(got, kern, rtol=1e-5)
+
+    def test_gradients_match_on_mixed_padding_targets(self):
+        h, w, b, t = _case()
+        t = t.at[:64].set(0)                           # part padding
+        gk = jax.grad(lambda h, w, b: linear_cross_entropy(
+            h, w, b, t, use_kernel=True, interpret=True),
+            argnums=(0, 1, 2))(h, w, b)
+        gx = jax.grad(lambda h, w, b: linear_cross_entropy(
+            h, w, b, t, use_kernel=False), argnums=(0, 1, 2))(h, w, b)
+        for a, e, name in zip(gk, gx, "h w b".split()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-5, atol=1e-6,
+                                       err_msg=f"d{name}")

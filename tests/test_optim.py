@@ -533,3 +533,13 @@ class TestSGDGroupedUpdate:
         # b: g = 0.5 + 0.1*1 = 0.6; p = 1 - 0.1*0.6 = 0.94
         np.testing.assert_allclose(np.asarray(p["b"]),
                                    np.full(4, 0.94), rtol=1e-6)
+
+    def test_per_param_hyper_tree_mismatch_raises(self):
+        """A partially-specified / misspelled hyper tree must fail loudly,
+        not broadcast as if it were a scalar."""
+        from bigdl_tpu.optim import SGD
+        params = {"a": jnp.ones(4), "b": jnp.ones(4)}
+        grads = {"a": jnp.full(4, 0.5), "b": jnp.full(4, 0.5)}
+        sgd = SGD(learning_rate=0.1, learning_rates={"a": 0.0})
+        with pytest.raises(ValueError, match="hyper tree"):
+            sgd.update(grads, params, sgd.init_state(params))
