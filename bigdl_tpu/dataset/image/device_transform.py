@@ -1,0 +1,43 @@
+"""On-device tail of the u8 input pipeline.
+
+The reference's host pipeline finishes with BGRImgNormalizer +
+MTLabeledBGRImgToBatch (dl/.../dataset/image/BGRImgNormalizer.scala:44-60,
+MTLabeledBGRImgToBatch.scala:46-103): float normalize and NCHW assembly on
+CPU threads. On a TPU host that work is the input-pipeline bottleneck
+(measured: the f32 host path runs at 867 img/s vs 1,915 img/s for
+decode-only, docs/PERF.md round 4), and it quadruples the host->device
+transfer (f32 vs u8). So the native loader ships raw uint8 HWC RGB crops
+and this transform — meant for ``Optimizer.set_input_transform`` so it
+lands INSIDE the jitted train/eval step — does scale/normalize/BGR/NCHW
+on-device, where XLA fuses it into the first convolution's input read.
+
+The math reproduces the host chain op-for-op in f32 (u8/255, subtract
+mean, divide std — division, not reciprocal-multiply, so results are
+bit-identical to BGRImgNormalizer's).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["u8_to_model_input"]
+
+
+def u8_to_model_input(mean_rgb, std_rgb, out_dtype=None):
+    """Build the jit-safe batch transform: (N, H, W, 3) uint8 RGB ->
+    (N, 3, H, W) normalized BGR in f32 (or ``out_dtype``, e.g. bf16 under
+    a mixed-precision policy — the cast happens after f32 normalize, the
+    same place DTypePolicy casts host f32 batches)."""
+    r, g, b = (float(v) for v in mean_rgb)
+    mean_bgr = jnp.asarray([b, g, r], jnp.float32)
+    r, g, b = (float(v) for v in std_rgb)
+    std_bgr = jnp.asarray([b, g, r], jnp.float32)
+
+    def transform(x):
+        if x.dtype != jnp.uint8:     # already normalized (f32 host path)
+            return x
+        y = x.astype(jnp.float32) / 255.0
+        y = (y[..., ::-1] - mean_bgr) / std_bgr      # RGB -> BGR, normalize
+        y = jnp.transpose(y, (0, 3, 1, 2))           # NHWC -> NCHW
+        return y if out_dtype is None else y.astype(out_dtype)
+
+    return transform

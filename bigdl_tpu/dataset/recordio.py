@@ -86,7 +86,7 @@ def read_records(path: str, skip: int = 0) -> Iterator[ByteRecord]:
             if n < skip:
                 f.seek(size, os.SEEK_CUR)
             else:
-                yield ByteRecord(f.read(size), label)
+                yield ByteRecord(f.read(size), label, key=(path, n))
 
 
 def shard_count(path: str) -> int:

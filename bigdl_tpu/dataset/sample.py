@@ -58,9 +58,14 @@ class MiniBatch:
 
 @dataclass
 class ByteRecord:
-    """Raw bytes + label (reference dataset/Types.scala ByteRecord)."""
+    """Raw bytes + label (reference dataset/Types.scala ByteRecord).
+
+    ``key``: optional stable identity (e.g. (shard path, record index),
+    set by ``recordio.read_records``) — the decoded-RAM cache keys by it
+    instead of re-hashing the payload bytes every epoch."""
     data: bytes
     label: float
+    key: object = None
 
 
 @dataclass

@@ -17,8 +17,9 @@ MEAN_RGB = (0.485, 0.456, 0.406)
 STD_RGB = (0.229, 0.224, 0.225)
 
 
-def build_pipeline(folder, batch, train, image_size=224, threads=8,
-                   prefetch_sharding=None):
+def build_pipeline(folder, batch, train, image_size=224, threads=None,
+                   prefetch_sharding=None, device_normalize=True,
+                   cache_bytes=0):
     """ImageNet input pipeline. Sharded record files (``*.brec``, produced
     by ``models.utils.imagenet_gen``) feed at pod speed — raw JPEG bytes
     stream from disk through per-worker decode threads with bounded
@@ -53,13 +54,17 @@ def build_pipeline(folder, batch, train, image_size=224, threads=8,
                                 process_index=jax.process_index(),
                                 process_count=jax.process_count())
         if native.available():
-            # C++ decode core: no GIL, one call per batch
-            # (dataset/image/native_batch.py)
+            # C++ decode core: no GIL, one call per batch; u8 crops out,
+            # normalize on-device (dataset/image/native_batch.py — pair
+            # with Optimizer.set_input_transform)
             from bigdl_tpu.dataset.image.native_batch import \
                 NativeBRecToBatch
             out = ds >> NativeBRecToBatch(batch, image_size, image_size,
                                           train, MEAN_RGB, STD_RGB,
-                                          num_threads=threads)
+                                          num_threads=threads,
+                                          device_normalize=device_normalize,
+                                          cache_bytes=cache_bytes
+                                          if train else 0)
             if prefetch_sharding is not None:
                 out = out >> DevicePrefetcher(prefetch_sharding)
             return out
@@ -81,6 +86,9 @@ def main(argv=None):
                         choices=["inception-v1", "inception-v2"])
     parser.add_argument("--classNum", type=int, default=1000)
     parser.add_argument("--maxIteration", type=int, default=62000)
+    parser.add_argument("--decodeCacheGB", type=float, default=0.0,
+                        help="decoded-image RAM cache budget (0 = off); "
+                             "post-warm epochs skip JPEG decode")
     args = parser.parse_args(argv)
     mesh = init_engine(args.chips)
 
@@ -99,7 +107,8 @@ def main(argv=None):
     # overlaps the device step (validation goes through eval_fn's own
     # padded placement)
     train_set = build_pipeline(args.folder, batch, train=True,
-                               prefetch_sharding=data_sharding(mesh))
+                               prefetch_sharding=data_sharding(mesh),
+                               cache_bytes=int(args.decodeCacheGB * 1e9))
     val_set = build_pipeline(args.folder, batch, train=False)
 
     if args.model:
@@ -110,6 +119,9 @@ def main(argv=None):
         model = Inception_v1_NoAuxClassifier(args.classNum)
 
     optimizer = Optimizer(model, train_set, nn.ClassNLLCriterion(), mesh=mesh)
+    # u8 batches normalize on-device; f32 batches pass through unchanged
+    from bigdl_tpu.dataset.image.device_transform import u8_to_model_input
+    optimizer.set_input_transform(u8_to_model_input(MEAN_RGB, STD_RGB))
     # reference recipe (inception/Train.scala:70-88): lr 0.0898,
     # Poly(0.5, maxIteration). When the run ends on --maxEpoch instead,
     # the Poly horizon must follow it, or LR hits 0 mid-run and the rest

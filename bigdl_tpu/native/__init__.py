@@ -19,7 +19,9 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "decode_crop_batch", "lib_path"]
+__all__ = ["available", "decode_crop_batch", "decode_crop_batch_u8",
+           "jpeg_dims", "crop_batch_from_raw", "record_seeds",
+           "default_threads", "lib_path"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, os.pardir, os.pardir, "native", "btr_loader.cpp")
@@ -82,8 +84,51 @@ def _load():
             ctypes.POINTER(ctypes.c_float),               # out
             ctypes.POINTER(ctypes.c_int8),                # status
         ]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.btr_decode_batch_u8.restype = ctypes.c_int
+        lib.btr_decode_batch_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),              # jpegs
+            ctypes.POINTER(ctypes.c_size_t),              # sizes
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,     # n, crop_h, crop_w
+            ctypes.c_int, ctypes.c_float, ctypes.c_int,   # rand, flip, fast
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,  # seeds, threads
+            u8p,                                          # out (n,h,w,3)
+            ctypes.POINTER(u8p),                          # full_outs | None
+            ctypes.POINTER(ctypes.c_int8),                # status
+        ]
+        lib.btr_jpeg_dims.restype = None
+        lib.btr_jpeg_dims.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.btr_crop_batch_from_raw.restype = None
+        lib.btr_crop_batch_from_raw.argtypes = [
+            ctypes.POINTER(u8p),                          # raws
+            ctypes.POINTER(ctypes.c_int32),               # hs
+            ctypes.POINTER(ctypes.c_int32),               # ws
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,     # n, crop_h, crop_w
+            ctypes.c_int, ctypes.c_float,                 # random, flip
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,  # seeds, threads
+            u8p,                                          # out
+        ]
         _lib = lib
         return _lib
+
+
+def default_threads() -> int:
+    """Decode threads sized to the host (the reference sizes its decode
+    pool to the executor's core count, Engine.coreNumber)."""
+    return max(2, os.cpu_count() or 1)
+
+
+def record_seeds(seed: int, indices) -> np.ndarray:
+    """Per-record augment-stream seeds: the same (seed, index) mix the
+    in-C scheme used, hoisted to Python so batches split across the
+    cache and decode paths keep the draws of an unsplit batch."""
+    idx = np.asarray(indices, np.uint64) + np.uint64(1)
+    return (np.uint64(seed & (2 ** 64 - 1))
+            ^ (np.uint64(0xd1342543de82ef95) * idx))
 
 
 def available() -> bool:
@@ -114,3 +159,95 @@ def decode_crop_batch(jpegs, crop_h: int, crop_w: int, *,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
     return out, status
+
+
+def decode_crop_batch_u8(jpegs, crop_h: int, crop_w: int, *,
+                         random_crop: bool = False, flip_prob: float = 0.0,
+                         fast_dct: bool = False, seed: int = 0,
+                         num_threads: int | None = None, full_outs=None):
+    """Decode JPEG byte strings into an (N, H, W, 3) uint8 RGB batch —
+    crop + flip only; normalize/BGR/NCHW runs on-device
+    (``dataset.image.device_transform``). The same (seed, index) splitmix
+    stream as ``decode_crop_batch`` cuts identical windows.
+
+    ``full_outs``: optional list (len N) whose non-None entries are
+    C-contiguous uint8 (h, w, 3) arrays (sized via ``jpeg_dims``) that
+    receive the FULL decoded image — the decoded-RAM-cache fill path.
+
+    ``seed`` may be an int (expanded via ``record_seeds`` over 0..N-1) or
+    a length-N uint64 array of explicit per-record seeds.
+    Returns (batch, status); status[i] != 0 marks a corrupt record."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no g++/libjpeg?)")
+    n = len(jpegs)
+    out = np.empty((n, crop_h, crop_w, 3), np.uint8)
+    status = np.empty((n,), np.int8)
+    arr = (ctypes.c_char_p * n)(*jpegs)
+    sizes = (ctypes.c_size_t * n)(*[len(j) for j in jpegs])
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    seeds = (record_seeds(seed, range(n)) if np.isscalar(seed)
+             or isinstance(seed, int) else
+             np.ascontiguousarray(seed, np.uint64))
+    fo = None
+    if full_outs is not None:
+        fo = (u8p * n)(*[
+            (a.ctypes.data_as(u8p) if a is not None else
+             ctypes.cast(None, u8p)) for a in full_outs])
+    lib.btr_decode_batch_u8(
+        ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), sizes, n,
+        crop_h, crop_w, int(random_crop), float(flip_prob), int(fast_dct),
+        seeds.ctypes.data_as(u64p),
+        num_threads if num_threads else default_threads(),
+        out.ctypes.data_as(u8p),
+        ctypes.cast(fo, ctypes.POINTER(u8p)) if fo is not None
+        else ctypes.cast(None, ctypes.POINTER(u8p)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    return out, status
+
+
+def jpeg_dims(jpegs):
+    """(heights, widths) int32 arrays from JPEG headers only; corrupt
+    records report (0, 0)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no g++/libjpeg?)")
+    n = len(jpegs)
+    hs = np.empty((n,), np.int32)
+    ws = np.empty((n,), np.int32)
+    arr = (ctypes.c_char_p * n)(*jpegs)
+    sizes = (ctypes.c_size_t * n)(*[len(j) for j in jpegs])
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.btr_jpeg_dims(ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)),
+                      sizes, n, hs.ctypes.data_as(i32p),
+                      ws.ctypes.data_as(i32p))
+    return hs, ws
+
+
+def crop_batch_from_raw(raws, crop_h: int, crop_w: int, *,
+                        random_crop: bool = False, flip_prob: float = 0.0,
+                        seed: int = 0, num_threads: int | None = None):
+    """Crop/flip an (N, H, W, 3)-per-item list of C-contiguous uint8
+    images (the decoded-RAM cache) into an (N, crop_h, crop_w, 3) batch —
+    the post-warm path: no JPEG decode at all. ``seed`` as in
+    ``decode_crop_batch_u8``."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no g++/libjpeg?)")
+    n = len(raws)
+    out = np.empty((n, crop_h, crop_w, 3), np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    seeds = (record_seeds(seed, range(n)) if np.isscalar(seed)
+             or isinstance(seed, int) else
+             np.ascontiguousarray(seed, np.uint64))
+    ptrs = (u8p * n)(*[a.ctypes.data_as(u8p) for a in raws])
+    hs = (ctypes.c_int32 * n)(*[a.shape[0] for a in raws])
+    ws = (ctypes.c_int32 * n)(*[a.shape[1] for a in raws])
+    lib.btr_crop_batch_from_raw(
+        ctypes.cast(ptrs, ctypes.POINTER(u8p)), hs, ws, n, crop_h, crop_w,
+        int(random_crop), float(flip_prob), seeds.ctypes.data_as(u64p),
+        num_threads if num_threads else default_threads(),
+        out.ctypes.data_as(u8p))
+    return out

@@ -58,11 +58,16 @@ class SpatialMaxPooling(_Pool2d):
     """(reference nn/SpatialMaxPooling.scala)
 
     Backward is XLA's select-and-scatter via autodiff, which also matches
-    Torch's first-max tie rule. Hand-written VJPs for the stride-1 pools
-    (shifted equality sums, window argmax) were benchmarked in round 2 and
-    all measured SLOWER end-to-end than select-and-scatter once the Pallas
-    LRN kernel was in place (docs/PERF.md) — don't reintroduce one without
-    a fresh whole-model measurement.
+    Torch's first-max tie rule. FOUR hand-written VJPs for the stride-1
+    pools have now been benchmarked and all measured SLOWER end-to-end
+    than select-and-scatter: round 2's three XLA-graph rewrites (shifted
+    equality sums, tie-splitting, stacked argmax), and round 4's fused
+    Pallas backward kernel (``ops/pallas/maxpool.py`` — bit-exact
+    first-max semantics, but 4,437 vs 5,056-5,252 img/s on the Inception
+    bench: the mask formulation needs ~45 VPU ops per element and is
+    compute-bound where S&S's hardware path is not; docs/PERF.md round
+    4). The kernel stays in-tree with interpret-mode parity tests but is
+    NOT dispatched — don't re-enable without a fresh whole-model win.
     """
 
     def apply(self, params, state, x, *, training=False, rng=None):

@@ -1,0 +1,222 @@
+"""Pallas TPU backward kernel for stride-1 SAME 3x3 max pooling.
+
+Why (VERDICT r3 #2): the round-3 HLO audit of the Inception-v1 train step
+put the 13 pool ops at ~10 ms of a 50 ms step — 3.7 GB of
+select-and-scatter backward + 2.2 GB of forward, already at near-minimal
+IO, so the remaining cost is S&S *execution* inefficiency, not bytes.
+The three round-2 hand-written VJPs were XLA-graph rewrites and all
+measured slower end-to-end (docs/PERF.md); this kernel is the never-tried
+fourth option: one fused Pallas pass for the backward.
+
+MEASURED OUTCOME (round 4, v5e batch 256): 4,437-4,439 img/s on the
+Inception bench vs 5,056-5,252 for plain select-and-scatter autodiff —
+REJECTED for dispatch (nn/pooling.py keeps S&S; this file stays as the
+recorded experiment with interpret-mode parity tests). Root cause: the
+first-max mask formulation costs ~45 VPU ops per element (9 compares +
+running-OR + select + 9 shifted adds); across the nine in-block pools
+that is ~238M elements/step ≈ 10 ms of pure VPU work — the backward is
+COMPUTE-bound on the vector unit, while XLA's S&S executes on a
+hardware path that is not. The round-3 audit's "S&S inefficiency"
+hypothesis is thereby falsified: S&S was already at the achievable
+floor. Tuning knobs tried: H-tile 4/2/whole-plane, c-tile 8/16 (bf16
+compares are unsupported by Mosaic, forcing f32 temps and small tiles).
+
+Scope: the nine IN-BLOCK pools (3x3, stride 1, SAME padding) — the
+majority of pool traffic; the stride-2 stem pools keep XLA S&S.
+Forward stays ``lax.reduce_window`` (minimal IO, efficient); only the
+backward is replaced, via ``jax.custom_vjp``:
+
+    dx[p] = sum_o  dy[j] * take_o[j],   p = j + offset_o
+    take_o[j] = (x[j + offset_o] == y[j]) and no earlier o' matched
+
+— the first-max tie rule in row-major window order, exactly Torch's and
+XLA S&S's semantics (reference nn/SpatialMaxPooling.scala backward loop).
+Using the forward's y as a residual means no in-kernel max recompute.
+
+Layout (the LRN playbook, ops/pallas/lrn.py): the kernel consumes a
+(H, W, C, N) VIEW of NCHW — row-major over XLA's native {0,1,3,2} conv
+activation layout, so the transpose folds to a bitcast. C rides sublanes,
+N rides lanes; W needs no alignment (major dim). H is tiled with 2-row
+(x) / 1-row (y, dy) halo BLOCKS — overlapping windows can't be expressed
+as disjoint BlockSpecs, so the halos are extra one-off block inputs whose
+index maps clamp at the array edge and whose out-of-range rows are masked
+in-kernel (x -> -inf, dy -> 0, reproducing SAME padding).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["maxpool3x3s1", "maxpool3x3s1_supported"]
+
+
+def _sublane(dtype) -> int:
+    return 16 if jnp.dtype(dtype).itemsize == 2 else 8
+
+
+# lane-axis tile: N beyond this is gridded
+_N_TILE = 256
+# H tile for large spatial planes (28x28 at Inception widths); small
+# planes (H <= 16: the 14x14 and 7x7 pools) run whole-plane
+_H_TILE = 4
+
+
+def maxpool3x3s1_supported(x) -> bool:
+    """Kernel constraints: TPU, NCHW, C a full sublane tile, N a full
+    lane tile (or a multiple), and H either small or H-tile divisible."""
+    if not (jax.default_backend() == "tpu" and x.ndim == 4):
+        return False
+    n, c, h, w = x.shape
+    return c % 8 == 0 and n % 128 == 0
+
+
+def _bwd_kernel(x_ref, xt_ref, xb_ref, y_ref, yt_ref, yb_ref,
+                g_ref, gt_ref, gb_ref, dx_ref, *, h, h_t, n_h):
+    """One (H-tile, C-tile, N-tile) program.
+
+    Row coordinate systems (local to this program; ht = rows of out):
+      out rows   p  : 0 .. ht-1            (global h_i*ht + p)
+      windows    j  : -1 .. ht             (y/dy rows, 1-row halos)
+      x rows        : -2 .. ht+1           (2-row halo blocks)
+    """
+    h_i = pl.program_id(0)
+    # comparisons run in f32 — Mosaic's TPU target rejects bf16 vector
+    # compares ("Target does not support this comparison"); the bf16->f32
+    # cast is exact so first-max semantics are unchanged
+    neg = jnp.finfo(jnp.float32).min
+
+    # assemble x rows [-2, ht+1], mask out-of-image rows to -inf (SAME pad)
+    x_all = jnp.concatenate([xt_ref[...], x_ref[...],
+                             xb_ref[...]], axis=0).astype(jnp.float32)
+    rows_x = jax.lax.broadcasted_iota(
+        jnp.int32, x_all.shape, 0) + h_i * h_t - 2
+    x_all = jnp.where((rows_x >= 0) & (rows_x < h), x_all, neg)
+
+    # y / dy rows [-1, ht]; OOB dy rows -> 0 (their windows don't exist)
+    y_all = jnp.concatenate([yt_ref[...], y_ref[...],
+                             yb_ref[...]], axis=0).astype(jnp.float32)
+    g_all = jnp.concatenate([gt_ref[...], g_ref[...], gb_ref[...]], axis=0)
+    rows_j = jax.lax.broadcasted_iota(
+        jnp.int32, g_all.shape, 0) + h_i * h_t - 1
+    g_all = jnp.where((rows_j >= 0) & (rows_j < h), g_all, 0)
+
+    w_ = x_ref.shape[1]
+    # W pads: x by 2 (-inf), y/dy by 1 (-inf / 0) — window cols j_c in
+    # [-1, W] read x cols [-2, W+1]; -inf pad reproduces SAME padding and
+    # can only "match" a -inf y, whose dy is 0
+    pad4 = [(0, 0)] * 2
+    x_p = jnp.pad(x_all, [(0, 0), (2, 2)] + pad4, constant_values=neg)
+    y_p = jnp.pad(y_all, [(0, 0), (1, 1)] + pad4, constant_values=neg)
+    g_p = jnp.pad(g_all, [(0, 0), (1, 1)] + pad4)
+
+    jr, jc = h_t + 2, w_ + 2                 # window-grid extent
+    cum = jnp.zeros(y_p.shape, jnp.bool_)
+    # dx accumulator over p rows [-2, ht+1], cols [-2, W+1] (then crop)
+    acc = jnp.zeros((h_t + 4, w_ + 4) + x_all.shape[2:], g_ref.dtype)
+    for dr in (-1, 0, 1):                    # row-major window order ==
+        for dc in (-1, 0, 1):                # torch first-max tie rule
+            v = jax.lax.slice(
+                x_p, (1 + dr, 1 + dc, 0, 0),
+                (1 + dr + jr, 1 + dc + jc) + x_p.shape[2:])
+            take = (v == y_p) & ~cum
+            cum = cum | take
+            contrib = jnp.where(take, g_p, 0)
+            # place contrib at offset (1+dr, 1+dc) in the acc extent via a
+            # static pad (dynamic_update_slice has no Pallas TPU lowering)
+            acc = acc + jnp.pad(
+                contrib, [(1 + dr, 1 - dr), (1 + dc, 1 - dc),
+                          (0, 0), (0, 0)])
+    dx_ref[...] = jax.lax.slice(
+        acc, (2, 2, 0, 0),
+        (2 + h_t, 2 + w_) + acc.shape[2:]).astype(dx_ref.dtype)
+
+
+def _bwd_call(x, y, g, interpret):
+    hw_h, w_, c, n = x.shape        # (H, W, C, N) view
+    # in-kernel temps are f32 (Mosaic can't compare bf16 vectors), so H
+    # tiles stay small; odd H (the 7x7 pools) runs whole-plane
+    if hw_h % _H_TILE == 0:
+        h_t = _H_TILE
+    elif hw_h % 2 == 0:
+        h_t = 2
+    else:
+        h_t = hw_h
+    n_h = pl.cdiv(hw_h, h_t)
+    c_t = 8
+    n_t = min(n, _N_TILE)
+    grid = (n_h, c // c_t, n // n_t)
+
+    def main_spec(rows):
+        return pl.BlockSpec((rows, w_, c_t, n_t),
+                            lambda hi, ci, ni: (hi, 0, ci, ni))
+
+    def halo_spec(rows, offset_rows, max_block):
+        # block index in units of `rows`; clamped at the edges (the
+        # kernel masks the out-of-range rows)
+        def index(hi, ci, ni):
+            blk = (hi * h_t + offset_rows) // rows
+            return (jnp.clip(blk, 0, max_block), 0, ci, ni)
+        return pl.BlockSpec((rows, w_, c_t, n_t), index)
+
+    max2 = (hw_h + 1) // 2 - 1      # last valid 2-row block index
+    kern = functools.partial(_bwd_kernel, h=hw_h, h_t=h_t, n_h=n_h)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=grid,
+        in_specs=[
+            main_spec(h_t),                      # x main
+            halo_spec(2, -2, max2),              # x rows -2..-1
+            halo_spec(2, h_t, max2),             # x rows ht..ht+1
+            main_spec(h_t),                      # y main
+            halo_spec(1, -1, hw_h - 1),          # y row -1
+            halo_spec(1, h_t, hw_h - 1),         # y row ht
+            main_spec(h_t),                      # dy main
+            halo_spec(1, -1, hw_h - 1),          # dy row -1
+            halo_spec(1, h_t, hw_h - 1),         # dy row ht
+        ],
+        out_specs=main_spec(h_t),
+        interpret=interpret,
+    )(x, x, x, y, y, y, g, g, g)
+
+
+def _to_view(t):
+    """NCHW -> (H, W, C, N): row-major over the conv activations' native
+    {0,1,3,2} physical layout, so XLA folds it to a bitcast."""
+    return jnp.transpose(t, (2, 3, 1, 0))
+
+
+def _from_view(t):
+    return jnp.transpose(t, (3, 2, 0, 1))
+
+
+def _fwd_xla(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, window_dimensions=(1, 1, 3, 3),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (1, 1), (1, 1)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def maxpool3x3s1(x, interpret=False):
+    """3x3 / stride-1 / SAME max pool over NCHW. Forward is XLA
+    ``reduce_window``; backward is the fused Pallas kernel (bit-exact
+    first-max semantics, no select-and-scatter)."""
+    return _fwd_xla(x)
+
+
+def _mp_fwd(x, interpret):
+    y = _fwd_xla(x)
+    return y, (x, y)
+
+
+def _mp_bwd(interpret, res, g):
+    x, y = res
+    dx = _bwd_call(_to_view(x), _to_view(y), _to_view(g), interpret)
+    return (_from_view(dx),)
+
+
+maxpool3x3s1.defvjp(_mp_fwd, _mp_bwd)

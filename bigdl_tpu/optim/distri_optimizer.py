@@ -135,6 +135,9 @@ class DistriOptimizer(Optimizer):
         opt_state = jax.device_put(opt_state, opt_shard)
 
         def train_step(params, mstate, opt_state, rng, data, labels, epoch):
+            if self.input_transform is not None:
+                data = self.input_transform(data)
+
             def loss_fn(p):
                 y, new_mstate = model.apply(p, mstate, data, training=True,
                                             rng=rng)
@@ -162,6 +165,8 @@ class DistriOptimizer(Optimizer):
                                # collective accounting reads the first HLO
 
         def eval_apply(params, mstate, data):
+            if self.input_transform is not None:
+                data = self.input_transform(data)
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 

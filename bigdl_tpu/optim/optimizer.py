@@ -87,6 +87,7 @@ class Optimizer:
         self.profile_iters = 0
         self._profiling = False
         self.grad_clip = None
+        self.input_transform = None
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -132,6 +133,16 @@ class Optimizer:
 
     def set_optim_method(self, method: OptimMethod):
         self.optim_method = method
+        return self
+
+    def set_input_transform(self, fn):
+        """Pure function applied to each batch's DATA inside the jitted
+        train/eval step — the hook the u8 input pipeline uses to run
+        normalize/BGR/NCHW on-device
+        (``dataset.image.device_transform.u8_to_model_input``) so the host
+        ships raw uint8 crops (4x smaller transfers) and the reference's
+        host-side BGRImgNormalizer work rides the TPU. Returns self."""
+        self.input_transform = fn
         return self
 
     def set_end_when(self, end_when: Trigger):
@@ -323,6 +334,9 @@ class LocalOptimizer(Optimizer):
             self._resume(optim, params)
 
         def train_step(params, mstate, opt_state, rng, data, labels, epoch):
+            if self.input_transform is not None:
+                data = self.input_transform(data)
+
             def loss_fn(p):
                 y, new_mstate = model.apply(p, mstate, data, training=True,
                                             rng=rng)
@@ -339,6 +353,8 @@ class LocalOptimizer(Optimizer):
         jit_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
         def eval_apply(params, mstate, data):
+            if self.input_transform is not None:
+                data = self.input_transform(data)
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 

@@ -50,6 +50,39 @@ inline double uniform01(uint64_t& state) {
   return (splitmix(state) >> 11) * (1.0 / 9007199254740992.0);
 }
 
+// Shared crop/flip draw — one stream order (y0, x0, flip) so the f32 and
+// u8 paths cut identical windows for the same (seed, record) pair.
+inline void draw_augment(uint64_t& rng, int h, int w, int crop_h,
+                         int crop_w, bool random_crop, float flip_prob,
+                         int* y0, int* x0, bool* flip) {
+  const int avail_h = h - crop_h, avail_w = w - crop_w;
+  if (random_crop) {
+    *y0 = avail_h > 0 ? static_cast<int>(uniform01(rng) * (avail_h + 1)) : 0;
+    *x0 = avail_w > 0 ? static_cast<int>(uniform01(rng) * (avail_w + 1)) : 0;
+  } else {
+    *y0 = std::max(avail_h / 2, 0);
+    *x0 = std::max(avail_w / 2, 0);
+  }
+  *flip = flip_prob > 0.0f && uniform01(rng) < flip_prob;
+}
+
+// copy one row of a decoded window into out, optionally mirrored
+inline void copy_row_u8(const uint8_t* src, int copy_w, int crop_w,
+                        bool flip, uint8_t* dst) {
+  (void)crop_w;
+  if (!flip) {
+    std::memcpy(dst, src, static_cast<size_t>(copy_w) * 3);
+    return;
+  }
+  // mirrored window: pixel x lands at copy_w-1-x (within the copied span,
+  // matching the f32 path's `ox = flip ? copy_w - 1 - x : x`)
+  for (int x = 0; x < copy_w; ++x) {
+    const uint8_t* px = src + static_cast<size_t>(x) * 3;
+    uint8_t* q = dst + static_cast<size_t>(copy_w - 1 - x) * 3;
+    q[0] = px[0]; q[1] = px[1]; q[2] = px[2];
+  }
+}
+
 // Decode one JPEG to packed RGB rows. Returns false on corrupt input.
 bool decode_rgb(const uint8_t* data, size_t size, std::vector<uint8_t>& rgb,
                 int* h, int* w) {
@@ -96,16 +129,10 @@ void process_one(const uint8_t* data, size_t size, int crop_h, int crop_w,
   }
   uint64_t rng = seed;
   int y0, x0;
-  const int avail_h = h - crop_h, avail_w = w - crop_w;
-  if (random_crop) {
-    // reference CropRandom: uniform offset over [0, size - crop]
-    y0 = avail_h > 0 ? static_cast<int>(uniform01(rng) * (avail_h + 1)) : 0;
-    x0 = avail_w > 0 ? static_cast<int>(uniform01(rng) * (avail_w + 1)) : 0;
-  } else {
-    y0 = std::max(avail_h / 2, 0);
-    x0 = std::max(avail_w / 2, 0);
-  }
-  const bool flip = flip_prob > 0.0f && uniform01(rng) < flip_prob;
+  bool flip;
+  // reference CropRandom: uniform offset over [0, size - crop]
+  draw_augment(rng, h, w, crop_h, crop_w, random_crop, flip_prob,
+               &y0, &x0, &flip);
 
   const int copy_h = std::min(crop_h, h), copy_w = std::min(crop_w, w);
   const size_t plane = static_cast<size_t>(crop_h) * crop_w;
@@ -128,7 +155,226 @@ void process_one(const uint8_t* data, size_t size, int crop_h, int crop_w,
   *status = 0;
 }
 
+// ---------------------------------------------------------------------------
+// u8 fast path: decode ONLY the crop window (libjpeg-turbo
+// jpeg_crop_scanline + jpeg_skip_scanlines) straight into a uint8 HWC RGB
+// batch; flip applied during the row copy. Normalize / BGR / NCHW moves
+// into the jitted TPU step (dataset/image/device_transform.py) — the host
+// does entropy decode + IDCT + memcpy and nothing else, which is what a
+// 1-core host can afford (measured roofline: full f32 path 755 img/s vs
+// raw decode 2.4-2.6k img/s; docs/PERF.md round 4).
+// ---------------------------------------------------------------------------
+
+// Decode one record into out (crop_h, crop_w, 3) u8 RGB. When full_out is
+// non-null it receives the FULL decoded image (cache fill; caller
+// allocated full_h*full_w*3 from btr_jpeg_dims) and the window is copied
+// from it.
+void process_one_u8(const uint8_t* data, size_t size, int crop_h,
+                    int crop_w, bool random_crop, float flip_prob,
+                    bool fast_dct, uint64_t seed, uint8_t* out,
+                    uint8_t* full_out, int8_t* status,
+                    std::vector<uint8_t>& scratch) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::memset(out, 0, static_cast<size_t>(3) * crop_h * crop_w);
+    *status = 1;
+    return;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    std::memset(out, 0, static_cast<size_t>(3) * crop_h * crop_w);
+    *status = 1;
+    return;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  if (fast_dct) cinfo.dct_method = JDCT_IFAST;
+  const int h = static_cast<int>(cinfo.image_height);
+  const int w = static_cast<int>(cinfo.image_width);
+  uint64_t rng = seed;
+  int y0, x0;
+  bool flip;
+  draw_augment(rng, h, w, crop_h, crop_w, random_crop, flip_prob,
+               &y0, &x0, &flip);
+  const int copy_h = std::min(crop_h, h), copy_w = std::min(crop_w, w);
+  const size_t row_bytes = static_cast<size_t>(crop_w) * 3;
+  jpeg_start_decompress(&cinfo);
+
+  const bool window_ok = full_out == nullptr && !cinfo.progressive_mode
+                         && w >= crop_w && h >= crop_h;
+  if (window_ok) {
+    // decode just the window, widened by a margin on each side (where the
+    // image allows): the fancy chroma upsampler loses left/right context
+    // at the decoded strip's edges, producing off-by-a-few values in the
+    // strip's first/last columns vs a full decode — with the margin those
+    // columns fall outside the copied window and the window is
+    // bit-identical to the full-decode path. crop_scanline additionally
+    // aligns the left edge down to an iMCU boundary; the wanted span then
+    // starts at x0 - xoff.
+    const int margin = 8;
+    const int want_left = std::max(0, x0 - margin);
+    const int want_right = std::min(w, x0 + crop_w + margin);
+    JDIMENSION xoff = static_cast<JDIMENSION>(want_left);
+    JDIMENSION xw = static_cast<JDIMENSION>(want_right - want_left);
+    jpeg_crop_scanline(&cinfo, &xoff, &xw);
+    int to_skip = y0;
+    while (to_skip > 0) {
+      const int skipped = static_cast<int>(
+          jpeg_skip_scanlines(&cinfo, static_cast<JDIMENSION>(to_skip)));
+      if (skipped <= 0) break;
+      to_skip -= skipped;
+    }
+    scratch.resize(static_cast<size_t>(cinfo.output_width) * 3);
+    const int xrel = x0 - static_cast<int>(xoff);
+    for (int y = 0; y < crop_h;) {
+      JSAMPROW row = scratch.data();
+      const int got = static_cast<int>(jpeg_read_scanlines(&cinfo, &row, 1));
+      if (got < 1) break;
+      copy_row_u8(scratch.data() + static_cast<size_t>(xrel) * 3, crop_w,
+                  crop_w, flip, out + static_cast<size_t>(y) * row_bytes);
+      ++y;
+    }
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    *status = 0;
+    return;
+  }
+
+  // full decode (progressive / undersized / cache-fill), then window copy
+  uint8_t* img;
+  if (full_out != nullptr) {
+    img = full_out;
+  } else {
+    scratch.resize(static_cast<size_t>(h) * w * 3);
+    img = scratch.data();
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = img + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  if (copy_h < crop_h || copy_w < crop_w)
+    std::memset(out, 0, static_cast<size_t>(3) * crop_h * crop_w);
+  for (int y = 0; y < copy_h; ++y) {
+    const uint8_t* src = img + (static_cast<size_t>(y0 + y) * w + x0) * 3;
+    copy_row_u8(src, copy_w, crop_w, flip,
+                out + static_cast<size_t>(y) * row_bytes);
+  }
+  *status = 0;
+}
+
+// crop/flip straight from a cached raw u8 (h, w, 3) image — no decode
+void crop_one_from_raw(const uint8_t* img, int h, int w, int crop_h,
+                       int crop_w, bool random_crop, float flip_prob,
+                       uint64_t seed, uint8_t* out) {
+  uint64_t rng = seed;
+  int y0, x0;
+  bool flip;
+  draw_augment(rng, h, w, crop_h, crop_w, random_crop, flip_prob,
+               &y0, &x0, &flip);
+  const int copy_h = std::min(crop_h, h), copy_w = std::min(crop_w, w);
+  const size_t row_bytes = static_cast<size_t>(crop_w) * 3;
+  if (copy_h < crop_h || copy_w < crop_w)
+    std::memset(out, 0, static_cast<size_t>(3) * crop_h * crop_w);
+  for (int y = 0; y < copy_h; ++y) {
+    const uint8_t* src = img + (static_cast<size_t>(y0 + y) * w + x0) * 3;
+    copy_row_u8(src, copy_w, crop_w, flip,
+                out + static_cast<size_t>(y) * row_bytes);
+  }
+}
+
 }  // namespace
+
+// Per-record header-only dims (for cache buffer allocation); dims of
+// corrupt records are (0, 0).
+extern "C" void btr_jpeg_dims(const uint8_t* const* jpegs,
+                              const size_t* sizes, int n, int32_t* hs,
+                              int32_t* ws) {
+  for (int i = 0; i < n; ++i) {
+    hs[i] = ws[i] = 0;
+    jpeg_decompress_struct cinfo;
+    ErrorMgr err;
+    cinfo.err = jpeg_std_error(&err.pub);
+    err.pub.error_exit = error_exit;
+    if (setjmp(err.jump)) {
+      jpeg_destroy_decompress(&cinfo);
+      continue;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, const_cast<uint8_t*>(jpegs[i]),
+                 static_cast<unsigned long>(sizes[i]));
+    if (jpeg_read_header(&cinfo, TRUE) == JPEG_HEADER_OK) {
+      hs[i] = static_cast<int32_t>(cinfo.image_height);
+      ws[i] = static_cast<int32_t>(cinfo.image_width);
+    }
+    jpeg_destroy_decompress(&cinfo);
+  }
+}
+
+// u8 batch decode: out is (n, crop_h, crop_w, 3) RGB. ``seeds`` holds one
+// augment-stream seed PER RECORD (computed by the Python side, so a batch
+// split across the cache-hit and decode paths draws the same windows as
+// an unsplit batch). full_outs may be NULL (no cache fill) or an array of
+// per-record pointers where non-NULL entries receive the full decoded
+// image (sized via btr_jpeg_dims).
+extern "C" int btr_decode_batch_u8(
+    const uint8_t* const* jpegs, const size_t* sizes, int n, int crop_h,
+    int crop_w, int random_crop, float flip_prob, int fast_dct,
+    const uint64_t* seeds, int num_threads, uint8_t* out,
+    uint8_t* const* full_outs, int8_t* status) {
+  const size_t rec = static_cast<size_t>(3) * crop_h * crop_w;
+  const int threads = std::max(1, std::min(num_threads, n));
+  std::atomic<int> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&]() {
+      std::vector<uint8_t> scratch;
+      int i;
+      while ((i = next.fetch_add(1)) < n) {
+        process_one_u8(jpegs[i], sizes[i], crop_h, crop_w,
+                       random_crop != 0, flip_prob, fast_dct != 0,
+                       seeds[i], out + i * rec,
+                       full_outs ? full_outs[i] : nullptr, status + i,
+                       scratch);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  int failures = 0;
+  for (int i = 0; i < n; ++i) failures += status[i] != 0;
+  return failures;
+}
+
+// crop/flip a batch from cached raw images (the post-warm cache path)
+extern "C" void btr_crop_batch_from_raw(
+    const uint8_t* const* raws, const int32_t* hs, const int32_t* ws,
+    int n, int crop_h, int crop_w, int random_crop, float flip_prob,
+    const uint64_t* seeds, int num_threads, uint8_t* out) {
+  const size_t rec = static_cast<size_t>(3) * crop_h * crop_w;
+  const int threads = std::max(1, std::min(num_threads, n));
+  std::atomic<int> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&]() {
+      int i;
+      while ((i = next.fetch_add(1)) < n) {
+        crop_one_from_raw(raws[i], hs[i], ws[i], crop_h, crop_w,
+                          random_crop != 0, flip_prob, seeds[i],
+                          out + i * rec);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
 
 extern "C" int btr_decode_batch(
     const uint8_t* const* jpegs, const size_t* sizes, int n, int crop_h,

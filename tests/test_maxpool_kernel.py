@@ -1,0 +1,61 @@
+"""Pallas max-pool backward kernel (ops/pallas/maxpool.py) —
+interpret-mode parity with XLA select-and-scatter autodiff.
+
+The kernel is NOT dispatched by SpatialMaxPooling (it measured slower
+end-to-end than S&S on TPU — docs/PERF.md round 4); these tests pin its
+correctness so the recorded experiment stays reproducible.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.pallas.maxpool import _fwd_xla, maxpool3x3s1
+
+
+def _case(n, c, h, w, seed=0, dtype=jnp.float32):
+    rs = np.random.default_rng(seed)
+    # small-integer values force ties inside windows; integer cotangents
+    # make the scatter sums exact, so parity can demand bit-equality
+    x = jnp.asarray(rs.integers(0, 4, size=(n, c, h, w)), dtype)
+    g = jnp.asarray(rs.integers(-8, 9, size=(n, c, h, w)), dtype)
+    return x, g
+
+
+GEOMETRIES = [(128, 16, 28, 28),    # H-tiled path (Inception 3a/3b size)
+              (128, 16, 14, 14),    # 2-row tiles
+              (128, 16, 7, 7),      # odd H -> whole-plane
+              (128, 8, 12, 9)]      # odd W, minimal C
+
+
+class TestMaxPoolKernelParity:
+    @pytest.mark.parametrize("shape", GEOMETRIES)
+    def test_bitexact_vs_select_and_scatter(self, shape):
+        x, g = _case(*shape)
+        y1, vjp1 = jax.vjp(_fwd_xla, x)
+        y2, vjp2 = jax.vjp(lambda v: maxpool3x3s1(v, True), x)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        np.testing.assert_array_equal(np.asarray(vjp1(g)[0]),
+                                      np.asarray(vjp2(g)[0]))
+
+    def test_bf16_bitexact(self):
+        x, g = _case(128, 16, 14, 14, seed=3, dtype=jnp.bfloat16)
+        _, vjp1 = jax.vjp(_fwd_xla, x)
+        _, vjp2 = jax.vjp(lambda v: maxpool3x3s1(v, True), x)
+        np.testing.assert_array_equal(
+            np.asarray(vjp1(g)[0].astype(jnp.float32)),
+            np.asarray(vjp2(g)[0].astype(jnp.float32)))
+
+    def test_tie_rule_is_first_max(self):
+        """An all-equal window must send the whole cotangent to the
+        first (row-major) element — torch's rule."""
+        x = jnp.ones((128, 8, 4, 4), jnp.float32)
+        g = jnp.ones((128, 8, 4, 4), jnp.float32)
+        _, vjp = jax.vjp(lambda v: maxpool3x3s1(v, True), x)
+        dx = np.asarray(vjp(g)[0])
+        _, vjp_ref = jax.vjp(_fwd_xla, x)
+        np.testing.assert_array_equal(dx, np.asarray(vjp_ref(g)[0]))
+        # window at (0,0) covers only (0..1, 0..1); its first element
+        # gets the grad — corner accumulates from 4 windows
+        assert dx[0, 0, 0, 0] == 4.0

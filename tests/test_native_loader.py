@@ -164,3 +164,190 @@ class TestNativeBatchTransformer:
         list(t(read_records(str(p))))
         probe_after = RandomGenerator.RNG()._rng.bit_generator.state
         assert str(probe_before) == str(probe_after)
+
+
+class TestU8DevicePath:
+    """device_normalize=True: u8 HWC crops on host + on-device normalize
+    tail == the f32 host path, bit-for-bit (same augment stream)."""
+
+    def _records(self, tmp_path, n=6):
+        from bigdl_tpu.dataset.recordio import RecordWriter, read_records
+        p = tmp_path / "s.brec"
+        with RecordWriter(str(p)) as w:
+            for i in range(n):
+                w.write(_jpeg(seed=i, h=40 + i, w=48 + i), float(i + 1))
+        return lambda: read_records(str(p))
+
+    def test_u8_plus_device_transform_matches_f32_path(self, tmp_path):
+        import jax.numpy as jnp
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.utils.random import RandomGenerator
+        recs = self._records(tmp_path)
+        kw = dict(train=True, mean_rgb=MEAN_RGB, std_rgb=STD_RGB)
+        RandomGenerator.seed_thread(5)
+        f32 = list(NativeBRecToBatch(6, 24, 24, **kw)(recs()))[0]
+        RandomGenerator.seed_thread(5)
+        t = NativeBRecToBatch(6, 24, 24, device_normalize=True, **kw)
+        u8 = list(t(recs()))[0]
+        assert u8.data.dtype == np.uint8
+        assert u8.data.shape == (6, 24, 24, 3)
+        got = np.asarray(t.device_transform()(jnp.asarray(u8.data)))
+        np.testing.assert_allclose(got, f32.data, atol=1e-6)
+        # non-u8 input passes through the transform untouched
+        same = t.device_transform()(jnp.asarray(f32.data))
+        np.testing.assert_array_equal(np.asarray(same), f32.data)
+
+    def test_decoded_ram_cache_reproduces_decode_path(self, tmp_path):
+        """Cache state must not change augmentation: pass 1 (cold, fills)
+        and pass 2 (all hits) equal the uncached path under the same host
+        RNG stream."""
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.utils.random import RandomGenerator
+        recs = self._records(tmp_path)
+        kw = dict(train=True, mean_rgb=MEAN_RGB, std_rgb=STD_RGB,
+                  device_normalize=True)
+        RandomGenerator.seed_thread(11)
+        plain = NativeBRecToBatch(6, 24, 24, **kw)
+        a1 = list(plain(recs()))[0].data
+        a2 = list(plain(recs()))[0].data
+        cached = NativeBRecToBatch(6, 24, 24, cache_bytes=10 ** 8, **kw)
+        RandomGenerator.seed_thread(11)
+        b1 = list(cached(recs()))[0].data     # cold: decode + fill
+        assert len(cached._cache) == 6
+        b2 = list(cached(recs()))[0].data     # warm: crop from RAM
+        np.testing.assert_array_equal(a1, b1)
+        np.testing.assert_array_equal(a2, b2)
+
+    def test_cache_budget_partial_fill(self, tmp_path):
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.utils.random import RandomGenerator
+        recs = self._records(tmp_path)
+        # budget fits roughly two 40x48 images
+        cached = NativeBRecToBatch(6, 24, 24, train=True,
+                                   mean_rgb=MEAN_RGB, std_rgb=STD_RGB,
+                                   device_normalize=True,
+                                   cache_bytes=2 * 42 * 50 * 3 + 100)
+        RandomGenerator.seed_thread(3)
+        list(cached(recs()))
+        assert 1 <= len(cached._cache) <= 3
+        assert cached._cache_left >= 0
+
+    def test_u8_corrupt_record_falls_back(self):
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.sample import ByteRecord
+        from bigdl_tpu.utils.random import RandomGenerator
+        RandomGenerator.seed_thread(1)
+        t = NativeBRecToBatch(1, 16, 16, train=False, mean_rgb=MEAN_RGB,
+                              std_rgb=STD_RGB, device_normalize=True)
+        with pytest.raises(Exception):
+            list(t(iter([ByteRecord(b"garbage", 1.0)])))
+
+
+    def test_u8_cmyk_fallback_matches_f32_fallback(self):
+        """A record libjpeg rejects but PIL decodes (CMYK JPEG) must ship
+        real pixels through _python_decode_one_u8, and the u8 fallback's
+        crop/flip/scale must agree with the f32 fallback's output under
+        the same seed (review finding: the roundtrip was untested)."""
+        import io
+        from PIL import Image
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.sample import ByteRecord
+        from bigdl_tpu.utils.random import RandomGenerator
+        rng = np.random.default_rng(0)
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 256, (40, 40, 4), np.uint8),
+                        "CMYK").save(buf, "JPEG", quality=95)
+        cmyk = buf.getvalue()
+        _, status = native.decode_crop_batch([cmyk], 24, 24)
+        if status[0] == 0:
+            pytest.skip("this libjpeg build decodes CMYK natively")
+        recs = lambda: iter([ByteRecord(_jpeg(), 1.0),
+                             ByteRecord(cmyk, 2.0)])
+        kw = dict(train=True, mean_rgb=MEAN_RGB, std_rgb=STD_RGB)
+        RandomGenerator.seed_thread(9)
+        f32 = list(NativeBRecToBatch(2, 24, 24, **kw)(recs()))[0]
+        RandomGenerator.seed_thread(9)
+        u8t = NativeBRecToBatch(2, 24, 24, device_normalize=True, **kw)
+        u8 = list(u8t(recs()))[0]
+        assert np.any(u8.data[1] != 0)            # real pixels, not zeros
+        import jax.numpy as jnp
+        got = np.asarray(u8t.device_transform()(jnp.asarray(u8.data)))
+        np.testing.assert_allclose(got[1], f32.data[1], atol=1e-6)
+
+    def test_seed_split_invariance(self):
+        """Partitioning a batch across sub-calls (the cache's hit/miss
+        split) keeps every record's augment draws."""
+        jpegs = [_jpeg(seed=i, h=64, w=64) for i in range(8)]
+        seeds = native.record_seeds(21, range(8))
+        whole, _ = native.decode_crop_batch_u8(
+            jpegs, 32, 32, random_crop=True, flip_prob=0.5, seed=21)
+        a, _ = native.decode_crop_batch_u8(
+            jpegs[:3], 32, 32, random_crop=True, flip_prob=0.5,
+            seed=seeds[:3])
+        b, _ = native.decode_crop_batch_u8(
+            jpegs[3:], 32, 32, random_crop=True, flip_prob=0.5,
+            seed=seeds[3:])
+        np.testing.assert_array_equal(np.concatenate([a, b]), whole)
+
+
+class TestEndToEndU8Training:
+    def test_local_training_u8_matches_f32_trajectory(self, tmp_path):
+        """The whole stack: .brec shards -> u8 native decode ->
+        DevicePrefetcher-style placement -> in-step device normalize ->
+        train. Loss trajectory equals the f32 host-normalize path."""
+        import jax
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.recordio import (RecordShardDataSet,
+                                                RecordWriter)
+        from bigdl_tpu.optim import Optimizer, SGD, max_iteration
+        from bigdl_tpu.utils.random import RandomGenerator
+
+        p = tmp_path / "s.brec"
+        with RecordWriter(str(p)) as w:
+            for i in range(16):
+                w.write(_jpeg(seed=i, h=36, w=36), float(i % 4 + 1))
+
+        def run(device_normalize):
+            RandomGenerator.seed_thread(77)
+            model = nn.Sequential(
+                nn.SpatialConvolution(3, 4, 3, 3, 2, 2),
+                nn.ReLU(), nn.Reshape([4 * 11 * 11]),
+                nn.Linear(4 * 11 * 11, 4))
+            model.materialize(jax.random.PRNGKey(0))
+            ds = RecordShardDataSet([str(p)])
+            batcher = NativeBRecToBatch(
+                8, 24, 24, train=True, mean_rgb=MEAN_RGB,
+                std_rgb=STD_RGB, device_normalize=device_normalize)
+            opt = Optimizer(model, ds >> batcher, nn.ClassNLLCriterion())
+            if device_normalize:
+                opt.set_input_transform(batcher.device_transform())
+            losses = []
+            orig = type(opt).optimize
+            opt.set_optim_method(SGD(learning_rate=0.05))
+            opt.set_end_when(max_iteration(6))
+            import logging
+
+            class Grab(logging.Handler):
+                def emit(self, rec):
+                    if "loss is" in rec.getMessage():
+                        losses.append(float(
+                            rec.getMessage().split("loss is ")[1]
+                            .split(",")[0]))
+            h = Grab()
+            lg = logging.getLogger("bigdl_tpu.optim")
+            prev = lg.level
+            lg.setLevel(logging.INFO)
+            lg.addHandler(h)
+            try:
+                orig(opt)
+            finally:
+                lg.removeHandler(h)
+                lg.setLevel(prev)
+            return losses
+
+        f32 = run(False)
+        u8 = run(True)
+        assert len(f32) == len(u8) == 6
+        np.testing.assert_allclose(u8, f32, rtol=1e-5)
+        assert u8[-1] < u8[0]          # it actually trains
