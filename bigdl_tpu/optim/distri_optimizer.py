@@ -48,7 +48,8 @@ class DistriOptimizer(Optimizer):
 
     def __init__(self, model, dataset, criterion, batch_size=None, *,
                  mesh=None, shard_optim_state: bool = False,
-                 tensor_parallel: bool | str = False, **kw):
+                 tensor_parallel: bool | str = False,
+                 sequence_parallel: bool | str = False, **kw):
         super().__init__(model, dataset, criterion, batch_size, **kw)
         self.mesh = mesh
         self.shard_optim_state = shard_optim_state
@@ -56,6 +57,12 @@ class DistriOptimizer(Optimizer):
         # axis and let XLA's SPMD partitioner split the math
         # (parallel/tensor_parallel.py)
         self.tensor_parallel = tensor_parallel
+        # True / axis name: shard the batch's SEQUENCE dim (dim 1) over
+        # the mesh 'seq' axis as well — pair with a model whose attention
+        # runs ring/Ulysses over that axis (models/transformer/model.py
+        # sequence_parallel=...). Composes with data and tensor
+        # parallelism: one jitted step over a dp x tp x sp mesh.
+        self.sequence_parallel = sequence_parallel
 
     def _account_collectives(self, compiled, n_devices: int) -> None:
         """Static per-step collective-bytes accounting from the compiled
@@ -78,7 +85,8 @@ class DistriOptimizer(Optimizer):
             "per chip (ring estimate)", acct["ops"],
             acct["logical_bytes"] / 1e6, acct["wire_bytes_per_chip"] / 1e6)
 
-    def _shard_batch(self, data, labels, sharding):
+    def _shard_batch(self, data, labels, sharding,
+                     label_sharding=None):
         """Lay a host batch out across the data axis.
 
         Multi-host: each process passes its local shard and the global
@@ -87,12 +95,19 @@ class DistriOptimizer(Optimizer):
         the reference's locality-zipped RDD partitions,
         ZippedPartitionsWithLocalityRDD.scala:27-118).
         """
+        if label_sharding is None:
+            # sequence-parallel: labels shard like data when they carry a
+            # sequence dim, over 'data' alone when rank-1
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            label_sharding = (sharding if np.ndim(labels) >= 2
+                              else NamedSharding(sharding.mesh, P("data")))
         if jax.process_count() > 1:
             data = jax.make_array_from_process_local_data(sharding, data)
-            labels = jax.make_array_from_process_local_data(sharding, labels)
+            labels = jax.make_array_from_process_local_data(label_sharding,
+                                                            labels)
             return data, labels
         return (jax.device_put(data, sharding),
-                jax.device_put(labels, sharding))
+                jax.device_put(labels, label_sharding))
 
     def optimize(self):
         model, criterion, optim = self.model, self.criterion, \
@@ -110,6 +125,28 @@ class DistriOptimizer(Optimizer):
 
         repl = replicated(mesh)
         batch_shard = data_sharding(mesh)
+        label_shard = batch_shard
+        sp_axis, sp_size = None, 1
+        if self.sequence_parallel:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sp_axis = (self.sequence_parallel
+                       if isinstance(self.sequence_parallel, str)
+                       else "seq")
+            sp_size = int(mesh.shape[sp_axis])
+            batch_shard = NamedSharding(mesh, P("data", sp_axis))
+            # labels may be rank-1 (sequence classification) — their
+            # placement is rank-derived per batch and the jitted step
+            # inherits it (in_shardings=None for that arg)
+            label_shard = None
+        # the batch's dim 0 shards over the axes named in the spec's
+        # first entry — a seq/model axis does not constrain batch size
+        dim0 = batch_shard.spec[0] if batch_shard.spec else None
+        if dim0 is None:
+            batch_div = 1
+        elif isinstance(dim0, (tuple, list)):
+            batch_div = int(np.prod([mesh.shape[a] for a in dim0]))
+        else:
+            batch_div = int(mesh.shape[dim0])
         param_shard, opt_shard = repl, repl
         tp_tree = None
         if self.tensor_parallel:
@@ -157,8 +194,10 @@ class DistriOptimizer(Optimizer):
         jit_step = jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
+            # label_shard is None under sequence_parallel (rank-derived at
+            # placement, _shard_batch); jit then inherits the arg sharding
             in_shardings=(param_shard, repl, opt_shard, repl, batch_shard,
-                          batch_shard, None),
+                          label_shard, None),
             out_shardings=(param_shard, repl, opt_shard, repl))
         compiled_steps = {}    # batch shape -> AOT executable (partial
                                # final batches recompile, like jit would);
@@ -211,16 +250,23 @@ class DistriOptimizer(Optimizer):
                 labels = np.asarray(batch.labels)
                 global_n = data.shape[0] * jax.process_count()
                 needs_shard = True
-            if global_n % n_shards != 0:
+            if global_n % batch_div != 0:
                 # a mesh-sharded DevicePrefetcher raised this before
                 # placement; this covers host batches, sharding-less
                 # prefetchers, and user-placed arrays
                 raise ValueError(
-                    f"global batch {global_n} not divisible by "
-                    f"{n_shards} mesh devices (reference Utils.getBatchSize "
-                    "divisibility requirement, dataset/Utils.scala:25-47)")
+                    f"global batch {global_n} not divisible by the "
+                    f"{batch_div} data-axis shards (reference "
+                    "Utils.getBatchSize divisibility requirement, "
+                    "dataset/Utils.scala:25-47)")
+            if sp_size > 1 and data.shape[1] % sp_size != 0:
+                raise ValueError(
+                    f"sequence length {data.shape[1]} not divisible by "
+                    f"the {sp_size}-way '{sp_axis}' mesh axis "
+                    "(sequence_parallel shards batch dim 1)")
             if needs_shard:
-                data, labels = self._shard_batch(data, labels, batch_shard)
+                data, labels = self._shard_batch(data, labels, batch_shard,
+                                                 label_shard)
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)

@@ -46,7 +46,7 @@ def _local_stack_apply(layer_apply, local_params, x):
 
 def pipeline_apply(layer_apply, stacked_params, x, *,
                    num_microbatches: int, axis: str = "model",
-                   mesh: Mesh | None = None):
+                   mesh: Mesh | None = None, data_axis: str | None = None):
     """Apply L stacked identical layers to ``x`` through an S-stage
     pipeline over mesh ``axis``.
 
@@ -55,6 +55,12 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
     ``stack_layer_params``); L must divide by the axis size S, the batch
     by ``num_microbatches``. Differentiable end-to-end; returns the same
     result as serially applying the L layers (up to float order).
+
+    ``data_axis`` composes the pipeline with data parallelism: the batch
+    dim shards over that mesh axis and each data-parallel row of the mesh
+    runs its own fill-drain pipeline over its batch shard (params stay
+    pipeline-sharded, replicated across ``data_axis``).
+    ``num_microbatches`` must then divide the per-row batch shard.
     """
     mesh = mesh or get_mesh()
     s = mesh.shape[axis]
@@ -63,6 +69,12 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
         raise ValueError(f"{n_layers} layers not divisible by "
                          f"{s} pipeline stages")
     batch = x.shape[0]
+    if data_axis is not None:
+        d = mesh.shape[data_axis]
+        if batch % d:
+            raise ValueError(f"batch {batch} not divisible by "
+                             f"data axis {d}")
+        batch = batch // d           # per-row shard seen inside the body
     if batch % num_microbatches:
         raise ValueError(f"batch {batch} not divisible by "
                          f"{num_microbatches} microbatches")
@@ -101,8 +113,9 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
         return out.reshape((batch,) + out.shape[2:])
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    xspec = P() if data_axis is None else P(data_axis)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, xspec),
+        out_specs=xspec,
         check_rep=False)(stacked_params, x)

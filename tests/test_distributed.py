@@ -319,3 +319,121 @@ def test_spatial_bn_cross_device_unbiased_running_var():
                        out_specs=P())(jnp.asarray(xg))
     want = 0.9 + 0.1 * np.var(xg, axis=(0, 2, 3), ddof=1)
     np.testing.assert_allclose(np.asarray(rv), want, rtol=1e-4)
+
+
+class TestComposedMeshAxes:
+    """dp x tp x seq in ONE jitted train step (VERDICT r3 #3): batch on
+    'data', params on 'model' (GSPMD), sequence on 'seq' (ring
+    attention) — trajectory parity with a plain single-device step."""
+
+    def _losses_via_log(self, run):
+        import logging
+        losses = []
+
+        class Grab(logging.Handler):
+            def emit(self, rec):
+                msg = rec.getMessage()
+                if "loss is" in msg:
+                    losses.append(float(
+                        msg.split("loss is ")[1].split(",")[0]))
+        lg = logging.getLogger("bigdl_tpu.optim")
+        prev = lg.level
+        lg.setLevel(logging.INFO)
+        h = Grab()
+        lg.addHandler(h)
+        try:
+            run()
+        finally:
+            lg.removeHandler(h)
+            lg.setLevel(prev)
+        return losses
+
+    def test_dp_tp_seq_transformer_trajectory_parity(self):
+        from bigdl_tpu.dataset import dataset as dsmod
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.models import TransformerLM
+
+        V, S, B, iters = 32, 8, 4, 3
+        rs = np.random.default_rng(0)
+        data = rs.integers(1, V + 1, size=(B, S))
+        labels = np.roll(data, -1, axis=1)
+        batches = [MiniBatch(data, labels)] * iters
+        crit = lambda: nn.TimeDistributedCriterion(  # noqa: E731
+            nn.ClassNLLCriterion(), size_average=True)
+
+        def build(sp):
+            model = TransformerLM(V, d_model=32, num_heads=4,
+                                  num_layers=2, max_len=S,
+                                  sequence_parallel=sp)
+            model.materialize(jax.random.PRNGKey(3))
+            return model
+
+        def run_mesh():
+            mesh = Engine.init(axes={"data": 2, "model": 2, "seq": 2})
+            ds = dsmod.iterator_source(lambda: iter(batches), size=B)
+            o = DistriOptimizer(build("ring"), ds, crit(), mesh=mesh,
+                                tensor_parallel=True,
+                                sequence_parallel=True)
+            o.set_optim_method(optim.SGD(learning_rate=0.1))
+            o.set_end_when(optim.max_iteration(iters))
+            o.optimize()
+
+        def run_local():
+            Engine.reset()
+            ds = dsmod.iterator_source(lambda: iter(batches), size=B)
+            from bigdl_tpu.optim.optimizer import LocalOptimizer
+            o = LocalOptimizer(build(None), ds, crit())
+            o.set_optim_method(optim.SGD(learning_rate=0.1))
+            o.set_end_when(optim.max_iteration(iters))
+            o.optimize()
+
+        mesh_losses = self._losses_via_log(run_mesh)
+        local_losses = self._losses_via_log(run_local)
+        assert len(mesh_losses) == len(local_losses) == iters
+        assert mesh_losses[-1] < mesh_losses[0]
+        np.testing.assert_allclose(mesh_losses, local_losses, rtol=2e-4)
+
+    def test_sequence_parallel_rank1_labels(self):
+        """Sequence classification under dp x seq: data (B, S, D) shards
+        P('data','seq'); rank-1 labels must shard over 'data' alone
+        (review finding: the data spec crashed on rank-1 labels)."""
+        from bigdl_tpu.dataset import dataset as dsmod
+        from bigdl_tpu.dataset.sample import MiniBatch
+
+        mesh = Engine.init(axes={"data": 2, "seq": 4})
+        rs = np.random.default_rng(0)
+        B, S, D = 4, 8, 32
+        data = rs.standard_normal((B, S, D)).astype(np.float32)
+        labels = rs.integers(1, 3, size=(B,))
+        ds = dsmod.iterator_source(
+            lambda: iter([MiniBatch(data, labels)] * 2), size=B)
+        model = nn.Sequential(
+            nn.MultiHeadAttention(D, 4, causal=True,
+                                  sequence_parallel="ring"),
+            nn.Mean(dimension=1),
+            nn.Linear(D, 2), nn.LogSoftMax())
+        model.materialize(jax.random.PRNGKey(0))
+        o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh,
+                            sequence_parallel=True)
+        o.set_optim_method(optim.SGD(learning_rate=0.05))
+        o.set_end_when(optim.max_iteration(2))
+        o.optimize()   # must run, not crash on label placement
+
+    def test_sequence_parallel_bad_seq_length_raises(self):
+        from bigdl_tpu.dataset import dataset as dsmod
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.models import TransformerLM
+
+        mesh = Engine.init(axes={"data": 4, "seq": 2})
+        rs = np.random.default_rng(0)
+        data = rs.integers(1, 17, size=(4, 7))     # 7 % 2 != 0
+        ds = dsmod.iterator_source(
+            lambda: iter([MiniBatch(data, np.roll(data, -1, 1))]), size=4)
+        lm = TransformerLM(16, d_model=32, num_heads=4, num_layers=1,
+                           max_len=7, sequence_parallel="ring")
+        o = DistriOptimizer(
+            lm, ds, nn.TimeDistributedCriterion(nn.ClassNLLCriterion()),
+            mesh=mesh, sequence_parallel=True)
+        o.set_end_when(optim.max_iteration(1))
+        with pytest.raises(ValueError, match="sequence length"):
+            o.optimize()

@@ -105,3 +105,81 @@ class TestPipeline:
             pipeline_apply(_layer_apply, stacked, x, num_microbatches=4,
                            mesh=mesh)
         Engine.reset()
+
+
+class TestPipelineRealBlocks:
+    """GPipe over REAL transformer blocks (LN + causal MHA + FFN as one
+    homogeneous layer pytree), alone and composed with a data axis
+    (VERDICT r3 #3) — pipelined == serial, values and gradients."""
+
+    def _blocks(self, n_layers=4, d=32, heads=4, seed=0):
+        from bigdl_tpu.models.transformer.model import TransformerBlock
+        template = TransformerBlock(d, heads)
+        template.materialize(jax.random.PRNGKey(seed))
+        blocks = []
+        for i in range(n_layers):
+            b = TransformerBlock(d, heads)
+            b.materialize(jax.random.PRNGKey(seed + 1 + i))
+            blocks.append(b.params)
+        state = template.state
+
+        def layer_apply(p, h):
+            y, _ = template.apply(p, state, h, training=False)
+            return y
+
+        return layer_apply, stack_layer_params(blocks), blocks
+
+    def _serial(self, layer_apply, blocks, x):
+        h = x
+        for p in blocks:
+            h = layer_apply(p, h)
+        return h
+
+    def test_transformer_blocks_match_serial(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": 4}, devices=jax.devices()[:4])
+        layer_apply, stacked, blocks = self._blocks()
+        rs = np.random.default_rng(1)
+        x = jnp.asarray(rs.standard_normal((8, 8, 32)).astype(np.float32))
+        want = self._serial(layer_apply, blocks, x)
+        got = pipeline_apply(layer_apply, stacked, x,
+                             num_microbatches=4, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        Engine.reset()
+
+    def test_transformer_blocks_composed_with_data_axis(self):
+        """dp x pp in one program: batch sharded over 'data', the block
+        stack pipelined over 'model'; values AND a full train-step grad
+        match the serial single-device computation."""
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 2, "model": 4},
+                           devices=jax.devices()[:8])
+        layer_apply, stacked, blocks = self._blocks()
+        rs = np.random.default_rng(2)
+        x = jnp.asarray(rs.standard_normal((8, 8, 32)).astype(np.float32))
+        t = jnp.asarray(rs.standard_normal((8, 8, 32)).astype(np.float32))
+
+        want = self._serial(layer_apply, blocks, x)
+        got = pipeline_apply(layer_apply, stacked, x, num_microbatches=2,
+                             mesh=mesh, data_axis="data")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+        def pp_loss(sp):
+            y = pipeline_apply(layer_apply, sp, x, num_microbatches=2,
+                               mesh=mesh, data_axis="data")
+            return jnp.mean((y - t) ** 2)
+
+        def serial_loss(sp):
+            layers = [jax.tree.map(lambda l, i=i: l[i], sp)
+                      for i in range(4)]
+            return jnp.mean((self._serial(layer_apply, layers, x) - t) ** 2)
+
+        l1, g1 = jax.jit(jax.value_and_grad(pp_loss))(stacked)
+        l2, g2 = jax.jit(jax.value_and_grad(serial_loss))(stacked)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-5)
+        Engine.reset()
