@@ -303,11 +303,19 @@ def bench_real_data(cache_gb: float = 0.0, timed_steps: int = 16):
     }
 
 
-def bench_transformer_lm(b: int = 8, s: int = 2048, vocab: int = 32768,
+def bench_transformer_lm(b: int = 4, s: int = 2048, vocab: int = 32768,
                          d_model: int = 1024, layers: int = 12,
-                         iters: int = 20):
+                         iters: int = 40):
     """LM train-step tokens/s + MFU at the docs/PERF.md flagship geometry
-    (GPT-2-medium width), fused-CE head + flash attention."""
+    (GPT-2-medium width), fused-CE head + flash attention.
+
+    MFU uses ANALYTIC step FLOPs (6 * matmul-params * tokens + attention)
+    — XLA's cost analysis cannot see inside the Pallas flash-attention
+    and fused-CE custom calls, so its count is only a lower bound
+    (reported as ``xla_counted_tflops``; round 3's 55.6% flagship figure
+    was this undercount). ``mfu`` counts attention at the full S^2
+    matrices (the PaLM-convention number most MFU figures quote);
+    ``mfu_causal_attn`` counts the causal halves actually computed."""
     import jax
     import jax.numpy as jnp
 
@@ -355,7 +363,16 @@ def bench_transformer_lm(b: int = 8, s: int = 2048, vocab: int = 32768,
     c = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
         params, mstate, opt_state, data, labels).compile()
     cost = c.cost_analysis()
-    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    xla_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    # analytic step FLOPs: matmul params = 2-D weight leaves minus the
+    # embedding tables (lookups, not matmuls)
+    p2d = sum(int(np.prod(l.shape))
+              for l in jax.tree.leaves(params) if l.ndim == 2)
+    p_matmul = p2d - vocab * d_model - s * d_model
+    tokens = b * s
+    dense_attn = 12 * layers * s * d_model * tokens
+    flops_dense = 6 * p_matmul * tokens + dense_attn
+    flops_causal = 6 * p_matmul * tokens + dense_attn // 2
     for _ in range(3):
         params, mstate, opt_state, loss = c(params, mstate, opt_state,
                                             data, labels)
@@ -368,17 +385,19 @@ def bench_transformer_lm(b: int = 8, s: int = 2048, vocab: int = 32768,
     dt = time.perf_counter() - t0
     if not np.isfinite(final):
         raise SystemExit(f"transformer bench diverged: loss={final}")
-    achieved = step_flops * iters / dt / 1e12
     peak = _chip_peak_tflops()
     out = {
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",
         "value": round(b * s * iters / dt, 1),
         "unit": "tokens/sec/chip",
         "geometry": f"d{d_model} L{layers} B{b} S{s} V{vocab}",
-        "achieved_tflops": round(achieved, 1),
+        "achieved_tflops": round(flops_dense * iters / dt / 1e12, 1),
+        "xla_counted_tflops": round(xla_flops * iters / dt / 1e12, 1),
     }
     if peak:
-        out["mfu"] = round(achieved / peak, 3)
+        out["mfu"] = round(flops_dense * iters / dt / 1e12 / peak, 3)
+        out["mfu_causal_attn"] = round(
+            flops_causal * iters / dt / 1e12 / peak, 3)
     return out
 
 
