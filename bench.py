@@ -200,6 +200,47 @@ def _ensure_shards() -> str:
     return SHARD_DIR
 
 
+def host_pipeline_probe(cache_gb: float) -> float:
+    """Host-only pipeline rate (shards -> u8 batches): run in a process
+    that has issued NO device work. Prints/returns img/s."""
+    from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+    from bigdl_tpu.dataset.recordio import RecordShardDataSet
+    from bigdl_tpu.models.inception.train import MEAN_RGB, STD_RGB
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    shards = _ensure_shards()
+    RandomGenerator.seed_thread(0)
+    ds = RecordShardDataSet(shards)
+    batcher = NativeBRecToBatch(
+        REAL_BATCH, 224, 224, train=True, mean_rgb=MEAN_RGB,
+        std_rgb=STD_RGB, device_normalize=True,
+        cache_bytes=int(cache_gb * 1e9))
+    it = batcher(ds.data(train=True))
+    warm = (SHARD_IMAGES // REAL_BATCH) if cache_gb > 0 else 2
+    for _ in range(warm):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        next(it)
+    return REAL_BATCH * 8 / (time.perf_counter() - t0)
+
+
+def _host_pipeline_probe_subprocess(cache_gb: float) -> float:
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--host-probe", str(cache_gb)],
+            capture_output=True, text=True, timeout=600, env=env)
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                return float(json.loads(line)["host_pipeline_img_per_sec"])
+    except Exception as e:
+        print(f"host probe subprocess failed: {e}", file=sys.stderr)
+    return float("nan")
+
+
 def bench_real_data(cache_gb: float = 0.0, timed_steps: int = 16):
     """End-to-end Inception train rate with JPEG bytes in the loop:
     .brec shards -> native u8 decode (crop-window, uint8 HWC) ->
@@ -241,16 +282,18 @@ def bench_real_data(cache_gb: float = 0.0, timed_steps: int = 16):
     jit_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
     rng = jax.random.PRNGKey(0)
 
-    # -- component 1: host pipeline rate (decode -> u8 batch, no device)
+    # -- component 1: host pipeline rate (decode -> u8 batch, no device),
+    # measured in a FRESH subprocess: once this process has run device
+    # work, the axon tunnel's polling threads consume ~half the single
+    # host core and halve the in-process decode rate (measured; the
+    # subprocess number is the true host capability a co-located
+    # deployment would see)
+    host_ips = _host_pipeline_probe_subprocess(cache_gb)
     steps_per_epoch = SHARD_IMAGES // REAL_BATCH
     host_it = batcher(ds.data(train=True))
     warm_batches = steps_per_epoch if cache_gb > 0 else 2
     for _ in range(warm_batches):        # cache mode: fill on pass 1
         host_batch = next(host_it)
-    t0 = time.perf_counter()
-    for _ in range(8):
-        host_batch = next(host_it)
-    host_ips = REAL_BATCH * 8 / (time.perf_counter() - t0)
 
     # -- component 2: device step rate on a resident u8 batch
     dev_data = jax.device_put(host_batch.data)
@@ -289,15 +332,20 @@ def bench_real_data(cache_gb: float = 0.0, timed_steps: int = 16):
     value = REAL_BATCH * timed_steps / dt
     name = ("inception_v1_train_real_jpeg_cached"
             if cache_gb > 0 else "inception_v1_train_real_jpeg")
+    import math
+    have_host = not math.isnan(host_ips)
+    bound = min(host_ips, device_ips) if have_host else None
     return {
         "metric": f"{name}_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
-        "host_pipeline_img_per_sec": round(host_ips, 1),
+        "host_pipeline_img_per_sec": round(host_ips, 1) if have_host
+        else None,
         "device_step_img_per_sec": round(device_ips, 1),
-        "colocated_bound_img_per_sec": round(min(host_ips, device_ips), 1),
-        "transfer_limited_by_tunnel": bool(
-            value < 0.8 * min(host_ips, device_ips)),
+        "colocated_bound_img_per_sec": round(bound, 1) if have_host
+        else None,
+        "transfer_limited_by_tunnel": bool(value < 0.8 * bound)
+        if have_host else None,
         "host_decode": "ram-cache" if cache_gb > 0 else "jpeg",
         "host_cores": os.cpu_count(),
     }
@@ -407,7 +455,13 @@ def main(argv=None):
     parser.add_argument("--rows", default="all",
                         help="comma list: headline,real,real_cached,"
                              "resnet50,vgg16,transformer")
+    parser.add_argument("--host-probe", type=float, default=None,
+                        help=argparse.SUPPRESS)   # subprocess entry
     args = parser.parse_args(argv)
+    if args.host_probe is not None:
+        _emit({"host_pipeline_img_per_sec":
+               round(host_pipeline_probe(args.host_probe), 1)})
+        return
     rows = (["headline"] if args.headline_only
             else [r.strip() for r in args.rows.split(",")])
     if args.rows == "all" and not args.headline_only:

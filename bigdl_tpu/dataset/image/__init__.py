@@ -3,7 +3,8 @@
 from bigdl_tpu.dataset.image.types import (LabeledImage, LabeledBGRImage,
                                            LabeledGreyImage)
 from bigdl_tpu.dataset.image.transforms import (
-    BytesToBGRImg, BytesToGreyImg, LocalImgReader, LocalImageFiles,
+    BytesToBGRImg, BytesToGreyImg, LocalImgReader, LocalImgReaderWithName,
+    BGRImgToImageVector, LocalImageFiles,
     BGRImgCropper, GreyImgCropper, BGRImgRdmCropper, CropRandom, CropCenter,
     BGRImgNormalizer, GreyImgNormalizer, BGRImgPixelNormalizer,
     HFlip, ColorJitter, Lighting,

@@ -28,7 +28,8 @@ from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.utils.random import RandomGenerator
 
 __all__ = [
-    "BytesToBGRImg", "BytesToGreyImg", "LocalImgReader", "LocalImageFiles",
+    "BytesToBGRImg", "BytesToGreyImg", "LocalImgReader",
+    "LocalImgReaderWithName", "BGRImgToImageVector", "LocalImageFiles",
     "BGRImgCropper", "GreyImgCropper", "BGRImgRdmCropper", "CropRandom",
     "CropCenter", "BGRImgNormalizer", "GreyImgNormalizer",
     "BGRImgPixelNormalizer", "HFlip", "ColorJitter", "Lighting",
@@ -96,6 +97,32 @@ class LocalImgReader(Transformer):
                 img = img.resize((nw, nh), Image.BILINEAR)
             rgb = np.asarray(img, np.float32) / self.normalize
             yield LabeledBGRImage(rgb[:, :, ::-1], label)
+
+
+class LocalImgReaderWithName(LocalImgReader):
+    """Like ``LocalImgReader`` but yields ``(image, file_name)`` pairs —
+    the DataFrame-facing variant (reference
+    LocalImgReaderWithName.scala:29-66: same decode/scale/normalize, plus
+    the path's file name for joining predictions back to rows)."""
+
+    def __call__(self, it):
+        import os
+        for path, label in it:
+            img = next(iter(super().__call__(iter([(path, label)]))))
+            yield img, os.path.basename(path)
+
+
+class BGRImgToImageVector(Transformer):
+    """LabeledBGRImage -> flat float64 feature vector (reference
+    BGRImgToImageVector.scala:33-49: ``copyTo(..., toRGB=True)`` then a
+    DenseVector — the Spark-ML ingestion shape). Channel order in the
+    flat vector is RGB-interleaved per pixel, matching the reference's
+    ``toRGB=true`` copy."""
+
+    def __call__(self, it):
+        for img in it:
+            rgb = img.content[:, :, ::-1]          # BGR planes -> RGB
+            yield rgb.reshape(-1).astype(np.float64)
 
 
 class LocalImageFiles:

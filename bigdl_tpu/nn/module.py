@@ -90,15 +90,29 @@ class Module:
             self.grad_params = jax.tree.map(jnp.zeros_like, self.params)
         return self
 
+    #: when True (default), ``forward``/``backward`` bracket their timers
+    #: with ``jax.block_until_ready`` so ``get_times()`` reports true
+    #: wall time like the reference's ``getTimes()``
+    #: (AbstractModule.scala:124-135), not async dispatch time. Set False
+    #: to keep the facade fully asynchronous (then the times are
+    #: dispatch-only; use ``Optimizer.set_profiler`` for device truth).
+    #: NOTE: through this container's axon tunnel block_until_ready is a
+    #: no-op — on that backend only the profiler gives per-op truth.
+    sync_times: bool = True
+
     def forward(self, x, rng=None):
         """Timed stateful forward (reference AbstractModule.scala:144-150)."""
         self.materialize()
+        if Module.sync_times:
+            jax.block_until_ready(x)   # charge upstream work upstream
         t0 = time.perf_counter()
         if rng is None and self._rng is not None:
             self._rng, rng = jax.random.split(self._rng)
         self._forward_rng = rng  # reused by backward for identical masks
         self.output, self.state = self.apply(
             self.params, self.state, x, training=self.training_mode, rng=rng)
+        if Module.sync_times:
+            jax.block_until_ready(self.output)
         self.forward_time += time.perf_counter() - t0
         return self.output
 
@@ -116,6 +130,8 @@ class Module:
         self.materialize()
         if rng is None:
             rng = getattr(self, "_forward_rng", None)
+        if Module.sync_times:
+            jax.block_until_ready((x, grad_output))
         t0 = time.perf_counter()
 
         def f(params, inp):
@@ -127,6 +143,8 @@ class Module:
         d_params, d_input = vjp(grad_output)
         self.grad_params = jax.tree.map(jnp.add, self.grad_params, d_params)
         self.grad_input = d_input
+        if Module.sync_times:
+            jax.block_until_ready((self.grad_params, d_input))
         self.backward_time += time.perf_counter() - t0
         return self.grad_input
 
@@ -214,7 +232,16 @@ class Module:
         return self._name or f"{type(self).__name__}@{id(self):x}"
 
     def get_times(self):
-        """[(module, forward_s, backward_s)] (reference ``getTimes()``)."""
+        """[(module, forward_s, backward_s)] (reference ``getTimes()``,
+        AbstractModule.scala:124-135).
+
+        With ``Module.sync_times`` (default True) the facade
+        ``forward``/``backward`` bracket their timers with
+        ``block_until_ready``, so these are true wall times on standard
+        backends. Children of a Container accumulate only when their own
+        ``forward`` is invoked — the Container's pure ``apply`` chain is
+        jit-compiled and cannot host per-child syncs; use
+        ``Optimizer.set_profiler`` for per-op device truth under jit."""
         return [(self, self.forward_time, self.backward_time)]
 
     def reset_times(self):
@@ -301,7 +328,10 @@ class Container(Module):
         return self
 
     def get_times(self):
-        out = []
+        # the container's own row first (its facade forward/backward time
+        # covers the whole jit-compiled chain; children accumulate only
+        # when individually forwarded — see Module.get_times)
+        out = [(self, self.forward_time, self.backward_time)]
         for m in self.modules:
             out.extend(m.get_times())
         return out

@@ -47,8 +47,23 @@ class Engine:
         pure data parallelism over every visible device.
         """
         global _mesh
+        import os
         devs = list(devices if devices is not None else jax.devices())
         n = len(devs)
+        # env-var surface (reference Engine.scala:232-287:
+        # DL_NODE_NUMBER / DL_CORE_NUMBER / DL_ENGINE_TYPE): accepted for
+        # script parity; on TPU JAX owns the real topology, so they only
+        # feed the same parity warning the explicit args do.
+        # DL_ENGINE_TYPE values other than the reference's mklblas are an
+        # error there (Engine.scala:272-277) — warn here.
+        if node_number is None and os.environ.get("DL_NODE_NUMBER"):
+            node_number = int(os.environ["DL_NODE_NUMBER"])
+        if core_number is None and os.environ.get("DL_CORE_NUMBER"):
+            core_number = int(os.environ["DL_CORE_NUMBER"])
+        engine_type = os.environ.get("DL_ENGINE_TYPE")
+        if engine_type and engine_type.lower() != "mklblas":
+            logger.warning(f"DL_ENGINE_TYPE={engine_type} has no TPU "
+                           "equivalent (XLA owns op dispatch); ignored")
         if axes is None:
             if node_number is not None:
                 want = node_number * (core_number or 1)
