@@ -5,7 +5,11 @@ assembles global batches via jax.make_array_from_process_local_data and
 trains in lockstep over the 8-device global mesh — the DCN code path
 (distri_optimizer._shard_batch multi-process branch).
 
-Usage: python multihost_worker.py <process_id> <num_processes> <port>
+Usage: python multihost_worker.py <process_id> <num_processes> <port> [mode]
+``mode``: "dp" (default, pure data parallel) or "dp_tp" (a {"data": 4,
+"model": 2} mesh with GSPMD tensor-parallel params — the composed-axes
+path ACROSS PROCESSES; TP is layout-only so losses still match the
+single-process control).
 Prints one line: ``LOSSES <pid> <json list>``.
 """
 import json
@@ -16,6 +20,7 @@ import sys
 
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
@@ -65,9 +70,15 @@ def main():
     model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
                           nn.LogSoftMax())
     Engine.reset()
-    mesh = Engine.init()          # all 8 global devices
-    o = optim.Optimizer(model=model, dataset=ds,
-                        criterion=nn.ClassNLLCriterion(), mesh=mesh)
+    if mode == "dp_tp":
+        mesh = Engine.init(axes={"data": 4, "model": 2})
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion(), mesh=mesh,
+                            tensor_parallel=True)
+    else:
+        mesh = Engine.init()      # all 8 global devices
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion(), mesh=mesh)
     o.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
     o.set_end_when(optim.max_iteration(4))
     o.optimize()
