@@ -3,10 +3,12 @@
 The reference's closest ancestor is ``MixtureTable`` (nn/MixtureTable.scala
 — dense gating over experts that all live everywhere). Expert parallelism
 is the TPU-scale version: each mesh shard OWNS one expert's parameters,
-tokens are routed top-1 by a learned gate, hop to their expert's device
-with one ``all_to_all``, run the expert, and hop back. Capacity-based
-dispatch (fixed C slots per expert) keeps every shape static for XLA;
-overflow tokens pass through unchanged (standard MoE practice).
+tokens are routed top-k by a learned gate (k=1 Switch-style default,
+k=2 GShard-style), hop to their experts' devices with one
+``all_to_all``, run the expert, and hop back. Capacity-based dispatch
+(fixed C slots per expert) keeps every shape static for XLA; overflow
+ranks drop, fully-dropped tokens pass through unchanged (standard MoE
+practice).
 
 Functional and differentiable end-to-end: the gate receives gradients
 through the combine weights, experts through their tokens.
@@ -25,8 +27,9 @@ __all__ = ["moe_apply"]
 
 def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
               capacity_factor: float = 1.25, axis: str = "model",
-              mesh: Mesh | None = None):
-    """Top-1 mixture of experts over mesh ``axis`` (one expert per shard).
+              mesh: Mesh | None = None, k: int = 1,
+              renormalize: bool = True):
+    """Top-k mixture of experts over mesh ``axis`` (one expert per shard).
 
     - ``expert_apply(expert_params, tokens) -> tokens``: one expert's pure
       function over (n, d) tokens.
@@ -34,9 +37,16 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
       (expert e's params live on shard e).
     - ``x``: (tokens, d), sharded over ``axis`` (each shard's local
       tokens); ``gate_w``: (d, E) replicated.
+    - ``k``: experts per token — 1 (Switch-style, the default) or 2+
+      (GShard-style). Ranks claim capacity slots in order (every token's
+      first choice before any second choice); a rank whose expert queue
+      is full is dropped for that rank only. ``renormalize`` divides the
+      k gate probs by their sum (GShard practice; ignored at k=1).
 
-    Returns (y, aux_loss) — y shaped like x; aux_loss is the standard
-    load-balancing loss (mean_e fraction_e * prob_e * E).
+    Returns (y, aux_loss) — y shaped like x (tokens with EVERY rank
+    dropped pass through unchanged); aux_loss is the standard
+    load-balancing loss over first-choice assignments
+    (E * sum_e fraction_e * prob_e).
     """
     mesh = mesh or get_mesh()
     e = mesh.shape[axis]
@@ -48,30 +58,45 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
     if gate_w.shape[-1] != e:
         raise ValueError(f"gate has {gate_w.shape[-1]} outputs for {e} "
                          "experts")
+    if not 1 <= k <= e:
+        raise ValueError(f"k={k} must be in [1, {e}]")
     import math
     t_local = x.shape[0] // e
     # true ceil: fractional headroom must survive small tokens-per-expert
-    cap = max(1, math.ceil(t_local * capacity_factor / e))
+    cap = max(1, math.ceil(k * t_local * capacity_factor / e))
 
     def body(expert_params, xb, gw):
         # xb: (t_local, d) — this shard's tokens
         f32 = jnp.float32
         logits = (xb.astype(f32) @ gw.astype(f32))            # (T, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        top = jnp.argmax(probs, axis=-1)                      # (T,)
-        top_p = jnp.take_along_axis(probs, top[:, None], 1)[:, 0]
+        top_p, top = jax.lax.top_k(probs, k)                  # (T, k)
+        if renormalize and k > 1:
+            top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
-        # position of each token within its expert's queue
-        onehot = jax.nn.one_hot(top, e, dtype=f32)            # (T, E)
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (T, E)
-        in_cap = (pos < cap) & (onehot > 0)                   # (T, E)
-        kept = jnp.any(in_cap, axis=-1)                       # (T,)
+        # rank-ordered capacity assignment: rank r's queue positions
+        # start where ranks < r left each expert's occupancy
+        occupied = jnp.zeros((e,), f32)
+        ranks = []
+        for r in range(k):
+            onehot = jax.nn.one_hot(top[:, r], e, dtype=f32)  # (T, E)
+            pos = ((jnp.cumsum(onehot, axis=0) - 1.0)
+                   + occupied[None, :]) * onehot              # (T, E)
+            in_cap = (pos < cap) & (onehot > 0)               # (T, E)
+            kept = jnp.any(in_cap, axis=-1)                   # (T,)
+            slot = jnp.where(in_cap, pos, 0.0) \
+                .sum(axis=-1).astype(jnp.int32)
+            occupied = occupied + jnp.sum(
+                jnp.where(in_cap, 1.0, 0.0), axis=0)
+            ranks.append((onehot, kept, slot))
 
-        # dispatch tensor (E, C, d): token t -> slot (top_t, pos_t)
-        slot = jnp.where(in_cap, pos, 0.0).sum(axis=-1).astype(jnp.int32)
+        # dispatch tensor (E, C, d): rank r of token t -> slot
+        # (top[t, r], slot_r[t]); ranks target distinct slots so the
+        # scatter-adds never collide
         disp = jnp.zeros((e, cap, xb.shape[1]), xb.dtype)
-        disp = disp.at[top, slot].add(
-            jnp.where(kept[:, None], xb, 0).astype(xb.dtype))
+        for r, (_, kept, slot) in enumerate(ranks):
+            disp = disp.at[top[:, r], slot].add(
+                jnp.where(kept[:, None], xb, 0).astype(xb.dtype))
 
         # to experts: all_to_all over the expert dim — shard i receives
         # (E, C, d) where dim 0 is the SOURCE shard, all for expert i
@@ -85,15 +110,22 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
                                   axis, split_axis=0, concat_axis=0,
                                   tiled=True)
 
-        # combine: gather each kept token's slot, weight by its gate prob;
-        # overflow tokens pass through
-        gathered = back[top, slot]                            # (T, d)
-        y = jnp.where(kept[:, None],
-                      gathered.astype(f32) * top_p[:, None],
-                      xb.astype(f32)).astype(xb.dtype)
+        # combine: sum each kept rank's expert output weighted by its
+        # gate prob; tokens with every rank dropped pass through
+        y = jnp.zeros(xb.shape, f32)
+        kept_any = jnp.zeros((xb.shape[0],), bool)
+        for r, (_, kept, slot) in enumerate(ranks):
+            gathered = back[top[:, r], slot]                  # (T, d)
+            y = y + jnp.where(kept[:, None],
+                              gathered.astype(f32)
+                              * top_p[:, r][:, None], 0.0)
+            kept_any = kept_any | kept
+        y = jnp.where(kept_any[:, None], y, xb.astype(f32)) \
+            .astype(xb.dtype)
 
-        # load-balancing loss (Shazeer-style): E * sum_e f_e * p_e
-        frac = jnp.mean(onehot, axis=0)
+        # load-balancing loss (Shazeer-style, over first choices):
+        # E * sum_e f_e * p_e
+        frac = jnp.mean(ranks[0][0], axis=0)
         mean_p = jnp.mean(probs, axis=0)
         aux = jnp.sum(frac * mean_p) * e
         aux = jax.lax.pmean(aux, axis)
