@@ -25,9 +25,11 @@ def _setup(e=8, t_per=8, d=16, seed=0):
     return stacked, experts, x, gate_w
 
 
-def _dense_reference(experts, x, gate_w, e, cap):
-    """Same math, no collectives: per-SHARD routing with per-expert
-    capacity, overflow passes through."""
+def _dense_reference_topk(experts, x, gate_w, e, cap, k=1,
+                          renormalize=True):
+    """Rank-ordered top-k routing with per-expert capacity; a dropped
+    rank loses its contribution, fully-dropped tokens pass through.
+    The single oracle for both the k=1 and k=2 tests."""
     t = x.shape[0] // e
     out = np.zeros_like(np.asarray(x))
     xs = np.asarray(x, np.float64)
@@ -37,18 +39,33 @@ def _dense_reference(experts, x, gate_w, e, cap):
         logits = xb @ gw
         p = np.exp(logits - logits.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
-        top = p.argmax(-1)
+        order = np.argsort(-p, axis=-1)
         counts = {ex: 0 for ex in range(e)}
+        kept = [[False] * k for _ in range(t)]
+        for r in range(k):                  # rank r claims before r+1
+            for i in range(t):
+                ex = int(order[i, r])
+                if counts[ex] < cap:
+                    kept[i][r] = True
+                    counts[ex] += 1
         for i in range(t):
-            ex = int(top[i])
-            if counts[ex] < cap:
-                counts[ex] += 1
-                y = np.tanh(xb[i] @ np.asarray(experts[ex]["w"],
-                                               np.float64))
-                out[s * t + i] = (y * p[i, ex]).astype(np.float32)
-            else:
-                out[s * t + i] = xb[i].astype(np.float32)
+            tot = sum(p[i, order[i, r]] for r in range(k))
+            y = np.zeros(xb.shape[1])
+            any_kept = False
+            for r in range(k):
+                if kept[i][r]:
+                    ex = int(order[i, r])
+                    w = (p[i, ex] / tot if renormalize and k > 1
+                         else p[i, ex])
+                    y += w * np.tanh(xb[i] @ np.asarray(
+                        experts[ex]["w"], np.float64))
+                    any_kept = True
+            out[s * t + i] = (y if any_kept else xb[i]).astype(np.float32)
     return out
+
+
+def _dense_reference(experts, x, gate_w, e, cap):
+    return _dense_reference_topk(experts, x, gate_w, e, cap, k=1)
 
 
 class TestExpertParallel:
@@ -97,44 +114,6 @@ class TestExpertParallel:
         Engine.reset()
 
 
-def _dense_reference_top2(experts, x, gate_w, e, cap, renormalize=True):
-    """Rank-ordered top-2 routing with per-expert capacity; a dropped
-    rank loses its contribution, fully-dropped tokens pass through."""
-    t = x.shape[0] // e
-    out = np.zeros_like(np.asarray(x))
-    xs = np.asarray(x, np.float64)
-    gw = np.asarray(gate_w, np.float64)
-    for s in range(e):
-        xb = xs[s * t:(s + 1) * t]
-        logits = xb @ gw
-        p = np.exp(logits - logits.max(-1, keepdims=True))
-        p /= p.sum(-1, keepdims=True)
-        order = np.argsort(-p, axis=-1)
-        counts = {ex: 0 for ex in range(e)}
-        kept = [[False, False] for _ in range(t)]
-        slots = [[0, 0] for _ in range(t)]
-        for r in range(2):                  # rank r claims before r+1
-            for i in range(t):
-                ex = int(order[i, r])
-                if counts[ex] < cap:
-                    kept[i][r] = True
-                    slots[i][r] = counts[ex]
-                    counts[ex] += 1
-        for i in range(t):
-            tot = p[i, order[i, 0]] + p[i, order[i, 1]]
-            y = np.zeros(xb.shape[1])
-            any_kept = False
-            for r in range(2):
-                if kept[i][r]:
-                    ex = int(order[i, r])
-                    w = p[i, ex] / tot if renormalize else p[i, ex]
-                    y += w * np.tanh(xb[i] @ np.asarray(
-                        experts[ex]["w"], np.float64))
-                    any_kept = True
-            out[s * t + i] = (y if any_kept else xb[i]).astype(np.float32)
-    return out
-
-
 class TestTop2Routing:
     def test_top2_matches_dense_reference(self):
         Engine.reset()
@@ -144,7 +123,7 @@ class TestTop2Routing:
         cap = max(1, math.ceil(2 * 8 * 1.25 / 8))
         y, aux = moe_apply(_expert_apply, stacked, x, gate_w, k=2,
                            capacity_factor=1.25, mesh=mesh)
-        ref = _dense_reference_top2(experts, x, gate_w, 8, cap)
+        ref = _dense_reference_topk(experts, x, gate_w, 8, cap, k=2)
         np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5,
                                    atol=2e-5)
         assert np.isfinite(float(aux)) and float(aux) > 0
