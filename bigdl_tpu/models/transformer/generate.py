@@ -79,13 +79,17 @@ def _ffn(p, x):
     return _linear(p["2"], jax.nn.relu(_linear(p["0"], x)))
 
 
-def _block_step(bp, x, ck, cv, pos, num_heads, max_len):
+def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False):
     """One TransformerBlock on a (B, T) slice ending at absolute position
     ``pos`` (T==1 decode or T==P prefill with pos==P-1). Returns output
     and the updated (ck, cv) cache for this layer.
 
     Param paths (TransformerBlock): bp["0"] = _Residual(LN, MHA),
     bp["1"] = _Residual(LN, FFN-Sequential).
+
+    ``rope=True`` rotates q/k at their absolute positions before caching
+    — a key's rotation is fixed at its own position, so the cache holds
+    rotated keys and decode steps never re-rotate history.
     """
     mha_p = bp["0"]["1"]
     h = _ln(bp["0"]["0"], x)
@@ -96,6 +100,11 @@ def _block_step(bp, x, ck, cv, pos, num_heads, max_len):
     v = _split_heads(_proj(mha_p, "v", h), num_heads)
     t = x.shape[1]
     start = pos - (t - 1)
+    if rope:
+        from bigdl_tpu.nn.attention import apply_rope
+        positions = start + jnp.arange(t)
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
                                       (0, start, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
@@ -134,9 +143,11 @@ def _model_parts(params, num_layers):
 def _embed(ep, tokens, start):
     idx = tokens.astype(jnp.int32) - 1        # 1-based ids
     vocab = ep["tok"].shape[0]
-    pos = jax.lax.dynamic_slice_in_dim(ep["pos"], start, tokens.shape[1],
-                                       axis=0)
-    return jnp.take(ep["tok"], jnp.clip(idx, 0, vocab - 1), axis=0) + pos
+    y = jnp.take(ep["tok"], jnp.clip(idx, 0, vocab - 1), axis=0)
+    if "pos" in ep:        # learned positions; absent under RoPE
+        y = y + jax.lax.dynamic_slice_in_dim(
+            ep["pos"], start, tokens.shape[1], axis=0)
+    return y
 
 
 def _logits(params, num_layers, x):
@@ -144,7 +155,8 @@ def _logits(params, num_layers, x):
     return _linear(head, _ln(norm, x[:, -1]))
 
 
-def _prefill(params, prompt, num_layers, num_heads, max_len):
+def _prefill(params, prompt, num_layers, num_heads, max_len,
+             rope=False):
     """Cache allocation + prompt prefill. Returns (ck, cv, x, pos0)."""
     embed, blocks, _, _ = _model_parts(params, num_layers)
     head_dim = embed["tok"].shape[1] // num_heads
@@ -160,7 +172,8 @@ def _prefill(params, prompt, num_layers, num_heads, max_len):
     pos0 = prompt.shape[1] - 1
     for li in range(num_layers):
         x, k_l, v_l = _block_step(blocks[li], x, zero(), zero(),
-                                  jnp.asarray(pos0), num_heads, max_len)
+                                  jnp.asarray(pos0), num_heads, max_len,
+                                  rope)
         ck.append(k_l)
         cv.append(v_l)
     return tuple(ck), tuple(cv), x, pos0
@@ -199,9 +212,10 @@ def _sample(logits, key, temperature, top_k):
 
 @functools.partial(jax.jit, static_argnames=(
     "num_layers", "num_heads", "max_len", "n_new", "temperature",
-    "top_k", "policy_key"))
+    "top_k", "policy_key", "rope"))
 def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
-                   max_len, n_new, temperature, top_k, policy_key):
+                   max_len, n_new, temperature, top_k, policy_key,
+                   rope=False):
     """The whole prefill+decode program as ONE module-level jitted
     function: repeated ``generate`` calls with the same shapes/config hit
     the jit cache instead of re-tracing a per-call closure (which
@@ -210,7 +224,7 @@ def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
     embed, blocks, _, _ = _model_parts(params, num_layers)
     dtype = activation_dtype()
     ck, cv, x, pos = _prefill(params, prompt, num_layers, num_heads,
-                              max_len)
+                              max_len, rope)
     logits = _logits(params, num_layers, x)
     rng, key0 = jax.random.split(rng)
     first = _sample(logits, key0, temperature, top_k)
@@ -223,7 +237,7 @@ def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
         for li in range(num_layers):
             x, new_ck[li], new_cv[li] = _block_step(
                 blocks[li], x, ck[li], cv[li], pos + 1, num_heads,
-                max_len)
+                max_len, rope)
         logits = _logits(params, num_layers, x)
         nxt = _sample(logits, key, temperature, top_k)
         return (nxt, tuple(new_ck), tuple(new_cv), pos + 1), nxt
@@ -256,7 +270,8 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
         params, prompt, rng, num_layers=meta["num_layers"],
         num_heads=meta["num_heads"], max_len=meta["max_len"],
         n_new=n_new, temperature=config.temperature, top_k=config.top_k,
-        policy_key=policy_key)
+        policy_key=policy_key,
+        rope=meta.get("pos_encoding", "learned") == "rope")
 
 
 def beam_search(model, prompt, *, num_beams: int = 4,
@@ -283,18 +298,20 @@ def beam_search(model, prompt, *, num_beams: int = 4,
         num_heads=meta["num_heads"], max_len=meta["max_len"],
         n_new=max_new_tokens, k=num_beams,
         length_penalty=length_penalty, eos_id=eos_id,
-        policy_key=policy_key)
+        policy_key=policy_key,
+        rope=meta.get("pos_encoding", "learned") == "rope")
 
 
 @functools.partial(jax.jit, static_argnames=(
     "num_layers", "num_heads", "max_len", "n_new", "k",
-    "length_penalty", "eos_id", "policy_key"))
+    "length_penalty", "eos_id", "policy_key", "rope"))
 def _beam_search_impl(params, prompt, *, num_layers, num_heads, max_len,
-                      n_new, k, length_penalty, eos_id, policy_key):
+                      n_new, k, length_penalty, eos_id, policy_key,
+                      rope=False):
     embed, blocks, _, _ = _model_parts(params, num_layers)
     dtype = activation_dtype()
     ck, cv, x, pos0 = _prefill(params, prompt, num_layers, num_heads,
-                               max_len)
+                               max_len, rope)
     b = prompt.shape[0]
     logp0 = jax.nn.log_softmax(
         _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
@@ -329,7 +346,8 @@ def _beam_search_impl(params, prompt, *, num_layers, num_heads, max_len,
         new_ck, new_cv = list(ck), list(cv)
         for li in range(num_layers):
             x, new_ck[li], new_cv[li] = _block_step(
-                blocks[li], x, ck[li], cv[li], pos, num_heads, max_len)
+                blocks[li], x, ck[li], cv[li], pos, num_heads, max_len,
+                rope)
         logp = jax.nn.log_softmax(
             _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
         logp = logp.reshape(b, k, vocab)

@@ -37,10 +37,12 @@ class _Residual(Container):
 
 def TransformerBlock(d_model: int, num_heads: int, ffn_mult: int = 4,
                      dropout: float = 0.0,
-                     sequence_parallel: str | None = None):
+                     sequence_parallel: str | None = None,
+                     rope: bool = False):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
     mha = nn.MultiHeadAttention(d_model, num_heads, causal=True,
-                                sequence_parallel=sequence_parallel)
+                                sequence_parallel=sequence_parallel,
+                                rope=rope)
     ffn = (nn.Sequential()
            .add(nn.Linear(d_model, ffn_mult * d_model))
            .add(nn.ReLU())
@@ -53,28 +55,34 @@ def TransformerBlock(d_model: int, num_heads: int, ffn_mult: int = 4,
 
 
 class _TokenAndPosition(Module):
-    """LookupTable embedding + learned positional embedding."""
+    """LookupTable embedding + learned positional embedding (or token
+    embedding alone under ``with_pos=False`` — the RoPE recipe, where
+    position enters through the attention rotation instead)."""
 
-    def __init__(self, vocab: int, d_model: int, max_len: int):
+    def __init__(self, vocab: int, d_model: int, max_len: int,
+                 with_pos: bool = True):
         super().__init__()
         self.vocab, self.d_model, self.max_len = vocab, d_model, max_len
+        self.with_pos = with_pos
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
         scale = 1.0 / np.sqrt(self.d_model)
-        return {"tok": jax.random.normal(
-                    k1, (self.vocab, self.d_model),
-                    default_dtype()) * scale,
-                "pos": jax.random.normal(
-                    k2, (self.max_len, self.d_model),
-                    default_dtype()) * scale}
+        p = {"tok": jax.random.normal(
+            k1, (self.vocab, self.d_model), default_dtype()) * scale}
+        if self.with_pos:
+            p["pos"] = jax.random.normal(
+                k2, (self.max_len, self.d_model), default_dtype()) * scale
+        return p
 
     def apply(self, params, state, x, *, training=False, rng=None):
         # x: (batch, seq) 1-based token ids (LookupTable convention)
         idx = x.astype(jnp.int32) - 1
         s = x.shape[1]
         y = jnp.take(params["tok"], jnp.clip(idx, 0, self.vocab - 1),
-                     axis=0) + params["pos"][:s]
+                     axis=0)
+        if self.with_pos:
+            y = y + params["pos"][:s]
         return y.astype(activation_dtype()), state
 
 
@@ -82,20 +90,29 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
                   num_layers: int = 2, max_len: int = 512,
                   ffn_mult: int = 4, dropout: float = 0.0,
                   sequence_parallel: str | None = None,
-                  with_log_softmax: bool = True) -> nn.Sequential:
+                  with_log_softmax: bool = True,
+                  pos_encoding: str = "learned") -> nn.Sequential:
     """Causal LM: tokens (B, S) -> log-probs (B, S, vocab).
 
     ``with_log_softmax=False`` ends at raw logits — pair it with
     ``CrossEntropyCriterion`` to skip materializing the f32 log-prob
     tensor (the memory-lean LM training recipe, docs/PERF.md).
+
+    ``pos_encoding``: "learned" (additive table, capped at ``max_len``)
+    or "rope" (rotary q/k rotation inside attention — no additive table,
+    no hard length cap beyond the decode cache's allocation).
     """
+    if pos_encoding not in ("learned", "rope"):
+        raise ValueError(f"pos_encoding={pos_encoding!r}")
+    rope = pos_encoding == "rope"
     model = (nn.Sequential()
-             .add(_TokenAndPosition(vocab_size, d_model, max_len)
+             .add(_TokenAndPosition(vocab_size, d_model, max_len,
+                                    with_pos=not rope)
                   .set_name("embed")))
     for i in range(num_layers):
         model.add(TransformerBlock(
             d_model, num_heads, ffn_mult, dropout,
-            sequence_parallel).set_name(f"block_{i}"))
+            sequence_parallel, rope=rope).set_name(f"block_{i}"))
     model.add(nn.LayerNorm(d_model).set_name("final_norm"))
     model.add(nn.Linear(d_model, vocab_size,
                         init_method=init_mod.Xavier).set_name("lm_head"))
@@ -104,5 +121,5 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     # decode-path metadata (models/transformer/generate.py)
     model.lm_meta = {"num_layers": num_layers, "num_heads": num_heads,
                      "max_len": max_len, "d_model": d_model,
-                     "vocab": vocab_size}
+                     "vocab": vocab_size, "pos_encoding": pos_encoding}
     return model

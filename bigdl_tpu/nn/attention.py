@@ -15,7 +15,30 @@ from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.tensor import activation_dtype, compute_dtype, default_dtype
 
-__all__ = ["MultiHeadAttention"]
+__all__ = ["MultiHeadAttention", "apply_rope"]
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding over the head dim (GPT-NeoX split-half
+    convention: pairs are (x[..., i], x[..., i + D/2])).
+
+    ``x``: (..., S, H, D) with D even; ``positions``: (S,) absolute token
+    positions (int). Rotation depends only on a token's own absolute
+    position, so scores q_m . k_n depend only on m - n (pinned by
+    tests/test_transformer.py) — the property that lets a KV cache store
+    rotated keys and lets ring/Ulysses sharding rotate before the
+    collective. Computed in f32, returned in x's dtype."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, hf)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
 
 
 class MultiHeadAttention(Module):
@@ -25,12 +48,19 @@ class MultiHeadAttention(Module):
     "ring" or "ulysses" (sequence-sharded over ``mesh_axis``; inputs must
     then be seq-sharded arrays under an active mesh, and seq/heads must
     divide the axis size — see parallel/sequence.py).
+
+    ``rope=True`` rotates q/k by absolute position (``apply_rope``)
+    before the attention core — pair with a model that skips additive
+    positional embeddings (``TransformerLM(pos_encoding="rope")``).
+    Composes with the sequence-parallel cores: rotation happens on the
+    (GSPMD-sharded) global arrays before the collective, and positions
+    are the global ``arange(S)``.
     """
 
     def __init__(self, embed_dim: int, num_heads: int,
                  causal: bool = False, with_bias: bool = True,
                  sequence_parallel: str | None = None,
-                 mesh_axis: str = "seq"):
+                 mesh_axis: str = "seq", rope: bool = False):
         super().__init__()
         assert embed_dim % num_heads == 0
         self.embed_dim, self.num_heads = embed_dim, num_heads
@@ -39,6 +69,9 @@ class MultiHeadAttention(Module):
         self.with_bias = with_bias
         self.sequence_parallel = sequence_parallel
         self.mesh_axis = mesh_axis
+        self.rope = rope
+        if rope:
+            assert self.head_dim % 2 == 0, "rope needs an even head_dim"
 
     def init(self, rng):
         ks = jax.random.split(rng, 4)
@@ -68,6 +101,10 @@ class MultiHeadAttention(Module):
         q = self._proj(params, "q", x).reshape(b, s, *heads)
         k = self._proj(params, "k", x).reshape(b, s, *heads)
         v = self._proj(params, "v", x).reshape(b, s, *heads)
+        if self.rope:
+            pos = jnp.arange(s)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         if self.sequence_parallel == "ring":
             o = seq.ring_attention(q, k, v, causal=self.causal,
                                    axis=self.mesh_axis)

@@ -237,3 +237,33 @@ def test_beam_eos_hypothesis_survives_pruning():
     assert beams[0, 0, 0] == first
     np.testing.assert_array_equal(beams[0, 0, 1:], 0)
     assert scores[0, 0] >= scores[0, 1]
+
+
+class TestRoPEDecoding:
+    """pos_encoding="rope": rotated-q/k cache decode must stay
+    token-exact with the growing-sequence forward."""
+
+    def _rope_model(self, seed=0):
+        m = TransformerLM(VOCAB, d_model=D, num_heads=HEADS,
+                          num_layers=LAYERS, max_len=MAXLEN,
+                          pos_encoding="rope")
+        m.materialize(jax.random.PRNGKey(seed))
+        m.evaluate()
+        return m
+
+    def test_rope_greedy_matches_growing_forward(self):
+        m = self._rope_model()
+        prompt = np.random.default_rng(7).integers(1, VOCAB + 1,
+                                                   size=(3, 7))
+        want = _oracle_greedy(m, prompt, 12)
+        got = np.asarray(generate(m, prompt, GenerationConfig(12)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_rope_beam_width1_matches_greedy(self):
+        from bigdl_tpu.models.transformer.generate import beam_search
+        m = self._rope_model(seed=2)
+        prompt = np.random.default_rng(8).integers(1, VOCAB + 1,
+                                                   size=(2, 5))
+        toks, _ = beam_search(m, prompt, num_beams=1, max_new_tokens=6)
+        want = _oracle_greedy(m, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(toks)[:, 0], want)

@@ -351,3 +351,43 @@ class TestEndToEndU8Training:
         assert len(f32) == len(u8) == 6
         np.testing.assert_allclose(u8, f32, rtol=1e-5)
         assert u8[-1] < u8[0]          # it actually trains
+
+
+class TestU8UnderMesh:
+    def test_u8_pipeline_feeds_distri_optimizer(self, tmp_path):
+        """The production wiring end-to-end on a mesh: .brec shards ->
+        u8 native decode -> DevicePrefetcher(mesh sharding) ->
+        DistriOptimizer with the in-step device transform."""
+        import jax
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.recordio import (DevicePrefetcher,
+                                                RecordShardDataSet,
+                                                RecordWriter)
+        from bigdl_tpu.optim import Optimizer, SGD, max_iteration
+        from bigdl_tpu.parallel import Engine
+        from bigdl_tpu.parallel.engine import data_sharding
+        from bigdl_tpu.utils.random import RandomGenerator
+
+        Engine.reset()
+        mesh = Engine.init()                     # 8-way data mesh
+        p = tmp_path / "s.brec"
+        with RecordWriter(str(p)) as w:
+            for i in range(32):
+                w.write(_jpeg(seed=i, h=36, w=36), float(i % 4 + 1))
+        RandomGenerator.seed_thread(5)
+        ds = RecordShardDataSet([str(p)])
+        batcher = NativeBRecToBatch(16, 24, 24, train=True,
+                                    mean_rgb=MEAN_RGB, std_rgb=STD_RGB,
+                                    device_normalize=True)
+        pipe = ds >> batcher >> DevicePrefetcher(data_sharding(mesh))
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3, 2, 2), nn.ReLU(),
+            nn.Reshape([4 * 11 * 11]), nn.Linear(4 * 11 * 11, 4))
+        model.materialize(jax.random.PRNGKey(0))
+        opt = Optimizer(model, pipe, nn.ClassNLLCriterion(), mesh=mesh)
+        opt.set_input_transform(batcher.device_transform())
+        opt.set_optim_method(SGD(learning_rate=0.05))
+        opt.set_end_when(max_iteration(4))
+        opt.optimize()                           # must run on the mesh
+        Engine.reset()
