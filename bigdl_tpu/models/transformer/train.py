@@ -23,6 +23,8 @@ def main(argv=None):
     parser.add_argument("--numLayers", type=int, default=2)
     parser.add_argument("--seqLength", type=int, default=128)
     parser.add_argument("--dropout", type=float, default=0.0)
+    parser.add_argument("--posEncoding", default="learned",
+                        choices=["learned", "rope"])
     parser.add_argument("--sequenceParallel", default=None,
                         choices=[None, "ring", "ulysses"])
     args = parser.parse_args(argv)
@@ -58,7 +60,8 @@ def main(argv=None):
                                 max_len=args.seqLength,
                                 dropout=args.dropout,
                                 sequence_parallel=args.sequenceParallel,
-                                with_log_softmax=False))
+                                with_log_softmax=False,
+                                pos_encoding=args.posEncoding))
     if isinstance(model.modules[-1], nn.LogSoftMax):
         # legacy snapshot with a log-softmax head: CE(log_softmax(x)) ==
         # CE(x) exactly (logsumexp of log-probs is 0), but keeping the

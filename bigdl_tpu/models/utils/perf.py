@@ -89,7 +89,8 @@ def _transformer_perf(args):
     model = TransformerLM(vocab, d_model=args.dModel,
                           num_heads=args.dModel // 128,
                           num_layers=args.numLayers,
-                          max_len=s, with_log_softmax=False)
+                          max_len=s, with_log_softmax=False,
+                          pos_encoding=args.posEncoding)
     model.materialize(jax.random.PRNGKey(0))
     model.training()
     # CrossEntropyCriterion flattens (B, S, V) itself; wrapping it in
@@ -228,6 +229,9 @@ def main(argv=None):
     parser.add_argument("--dModel", type=int, default=512,
                         help="transformer mode: model width (heads = "
                              "dModel/128)")
+    parser.add_argument("--posEncoding", default="learned",
+                        choices=["learned", "rope"],
+                        help="transformer position encoding")
     parser.add_argument("--numLayers", type=int, default=6,
                         help="transformer mode: layers")
     args = parser.parse_args(argv)
