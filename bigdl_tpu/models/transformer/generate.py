@@ -79,7 +79,8 @@ def _ffn(p, x):
     return _linear(p["2"], jax.nn.relu(_linear(p["0"], x)))
 
 
-def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False):
+def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False,
+                num_kv_heads=None):
     """One TransformerBlock on a (B, T) slice ending at absolute position
     ``pos`` (T==1 decode or T==P prefill with pos==P-1). Returns output
     and the updated (ck, cv) cache for this layer.
@@ -92,12 +93,13 @@ def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False):
     rotated keys and decode steps never re-rotate history.
     """
     mha_p = bp["0"]["1"]
+    kv = num_kv_heads or num_heads
     h = _ln(bp["0"]["0"], x)
     d = h.shape[-1]
     scale = (d // num_heads) ** -0.5
     q = _split_heads(_proj(mha_p, "q", h), num_heads)
-    k = _split_heads(_proj(mha_p, "k", h), num_heads)
-    v = _split_heads(_proj(mha_p, "v", h), num_heads)
+    k = _split_heads(_proj(mha_p, "k", h), kv)
+    v = _split_heads(_proj(mha_p, "v", h), kv)
     t = x.shape[1]
     start = pos - (t - 1)
     if rope:
@@ -116,13 +118,29 @@ def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False):
     # what made batch-128 decode REGRESS below batch 64 (2 GB of
     # converts/step at B=128; round 3, docs/PERF.md)
     upto = start + jnp.arange(t)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(ck.dtype), ck,
-                   preferred_element_type=jnp.float32) * scale
-    kpos = jnp.arange(max_len)[None, None, None, :]
-    s = jnp.where(kpos > upto[None, None, :, None], -1e9, s)
-    o = jnp.einsum("bhqk,bkhd->bqhd",
-                   jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if kv != num_heads:
+        # GQA: the cache stays at kv heads (the memory win); queries
+        # group as (B, T, kv, G, D) so no repeated kv materializes
+        g = num_heads // kv
+        b_, t_ = x.shape[0], t
+        hd = q.shape[-1]
+        qg = q.reshape(b_, t_, kv, g, hd)
+        s = jnp.einsum("btkgd,bmkd->bkgtm", qg.astype(ck.dtype), ck,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = jnp.arange(max_len)[None, None, None, None, :]
+        s = jnp.where(kpos > upto[None, None, None, :, None], -1e9, s)
+        o = jnp.einsum("bkgtm,bmkd->btkgd",
+                       jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32)
+        o = o.reshape(b_, t_, num_heads, hd).astype(x.dtype)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(ck.dtype), ck,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = jnp.arange(max_len)[None, None, None, :]
+        s = jnp.where(kpos > upto[None, None, :, None], -1e9, s)
+        o = jnp.einsum("bhqk,bkhd->bqhd",
+                       jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
     o = _proj(mha_p, "out",
               o.reshape(x.shape)).astype(activation_dtype())
     x = x + o
@@ -156,7 +174,7 @@ def _logits(params, num_layers, x):
 
 
 def _prefill(params, prompt, num_layers, num_heads, max_len,
-             rope=False):
+             rope=False, num_kv_heads=None):
     """Cache allocation + prompt prefill. Returns (ck, cv, x, pos0)."""
     embed, blocks, _, _ = _model_parts(params, num_layers)
     head_dim = embed["tok"].shape[1] // num_heads
@@ -166,14 +184,15 @@ def _prefill(params, prompt, num_layers, num_heads, max_len,
     # cache is then its own scan-carry leaf, which XLA updates in place —
     # the stacked form's .at[li].set forced whole-cache copies per step
     # (measured: batch-64 decode 212 -> 4.06 ms/step)
-    zero = lambda: jnp.zeros((b, max_len, num_heads, head_dim), dtype)
+    kv = num_kv_heads or num_heads
+    zero = lambda: jnp.zeros((b, max_len, kv, head_dim), dtype)
     ck, cv = [], []
     x = _embed(embed, prompt, 0).astype(dtype)
     pos0 = prompt.shape[1] - 1
     for li in range(num_layers):
         x, k_l, v_l = _block_step(blocks[li], x, zero(), zero(),
                                   jnp.asarray(pos0), num_heads, max_len,
-                                  rope)
+                                  rope, num_kv_heads)
         ck.append(k_l)
         cv.append(v_l)
     return tuple(ck), tuple(cv), x, pos0
@@ -212,10 +231,10 @@ def _sample(logits, key, temperature, top_k):
 
 @functools.partial(jax.jit, static_argnames=(
     "num_layers", "num_heads", "max_len", "n_new", "temperature",
-    "top_k", "policy_key", "rope"))
+    "top_k", "policy_key", "rope", "num_kv_heads"))
 def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
                    max_len, n_new, temperature, top_k, policy_key,
-                   rope=False):
+                   rope=False, num_kv_heads=None):
     """The whole prefill+decode program as ONE module-level jitted
     function: repeated ``generate`` calls with the same shapes/config hit
     the jit cache instead of re-tracing a per-call closure (which
@@ -224,7 +243,7 @@ def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
     embed, blocks, _, _ = _model_parts(params, num_layers)
     dtype = activation_dtype()
     ck, cv, x, pos = _prefill(params, prompt, num_layers, num_heads,
-                              max_len, rope)
+                              max_len, rope, num_kv_heads)
     logits = _logits(params, num_layers, x)
     rng, key0 = jax.random.split(rng)
     first = _sample(logits, key0, temperature, top_k)
@@ -237,7 +256,7 @@ def _generate_impl(params, prompt, rng, *, num_layers, num_heads,
         for li in range(num_layers):
             x, new_ck[li], new_cv[li] = _block_step(
                 blocks[li], x, ck[li], cv[li], pos + 1, num_heads,
-                max_len, rope)
+                max_len, rope, num_kv_heads)
         logits = _logits(params, num_layers, x)
         nxt = _sample(logits, key, temperature, top_k)
         return (nxt, tuple(new_ck), tuple(new_cv), pos + 1), nxt
@@ -271,7 +290,8 @@ def generate(model, prompt, config: GenerationConfig | None = None, *,
         num_heads=meta["num_heads"], max_len=meta["max_len"],
         n_new=n_new, temperature=config.temperature, top_k=config.top_k,
         policy_key=policy_key,
-        rope=meta.get("pos_encoding", "learned") == "rope")
+        rope=meta.get("pos_encoding", "learned") == "rope",
+        num_kv_heads=meta.get("num_kv_heads"))
 
 
 def beam_search(model, prompt, *, num_beams: int = 4,
@@ -299,19 +319,20 @@ def beam_search(model, prompt, *, num_beams: int = 4,
         n_new=max_new_tokens, k=num_beams,
         length_penalty=length_penalty, eos_id=eos_id,
         policy_key=policy_key,
-        rope=meta.get("pos_encoding", "learned") == "rope")
+        rope=meta.get("pos_encoding", "learned") == "rope",
+        num_kv_heads=meta.get("num_kv_heads"))
 
 
 @functools.partial(jax.jit, static_argnames=(
     "num_layers", "num_heads", "max_len", "n_new", "k",
-    "length_penalty", "eos_id", "policy_key", "rope"))
+    "length_penalty", "eos_id", "policy_key", "rope", "num_kv_heads"))
 def _beam_search_impl(params, prompt, *, num_layers, num_heads, max_len,
                       n_new, k, length_penalty, eos_id, policy_key,
-                      rope=False):
+                      rope=False, num_kv_heads=None):
     embed, blocks, _, _ = _model_parts(params, num_layers)
     dtype = activation_dtype()
     ck, cv, x, pos0 = _prefill(params, prompt, num_layers, num_heads,
-                               max_len, rope)
+                               max_len, rope, num_kv_heads)
     b = prompt.shape[0]
     logp0 = jax.nn.log_softmax(
         _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
@@ -347,7 +368,7 @@ def _beam_search_impl(params, prompt, *, num_layers, num_heads, max_len,
         for li in range(num_layers):
             x, new_ck[li], new_cv[li] = _block_step(
                 blocks[li], x, ck[li], cv[li], pos, num_heads, max_len,
-                rope)
+                rope, num_kv_heads)
         logp = jax.nn.log_softmax(
             _logits(params, num_layers, x).astype(jnp.float32), axis=-1)
         logp = logp.reshape(b, k, vocab)

@@ -38,11 +38,12 @@ class _Residual(Container):
 def TransformerBlock(d_model: int, num_heads: int, ffn_mult: int = 4,
                      dropout: float = 0.0,
                      sequence_parallel: str | None = None,
-                     rope: bool = False):
+                     rope: bool = False,
+                     num_kv_heads: int | None = None):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
     mha = nn.MultiHeadAttention(d_model, num_heads, causal=True,
                                 sequence_parallel=sequence_parallel,
-                                rope=rope)
+                                rope=rope, num_kv_heads=num_kv_heads)
     ffn = (nn.Sequential()
            .add(nn.Linear(d_model, ffn_mult * d_model))
            .add(nn.ReLU())
@@ -91,7 +92,8 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
                   ffn_mult: int = 4, dropout: float = 0.0,
                   sequence_parallel: str | None = None,
                   with_log_softmax: bool = True,
-                  pos_encoding: str = "learned") -> nn.Sequential:
+                  pos_encoding: str = "learned",
+                  num_kv_heads: int | None = None) -> nn.Sequential:
     """Causal LM: tokens (B, S) -> log-probs (B, S, vocab).
 
     ``with_log_softmax=False`` ends at raw logits — pair it with
@@ -101,6 +103,11 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     ``pos_encoding``: "learned" (additive table, capped at ``max_len``)
     or "rope" (rotary q/k rotation inside attention — no additive table,
     no hard length cap beyond the decode cache's allocation).
+
+    ``num_kv_heads`` < ``num_heads`` selects grouped-query attention:
+    the decode KV cache shrinks by num_heads/num_kv_heads (the
+    batch-scaling lever for serving; generate.py keeps the cache at kv
+    heads and groups queries instead of repeating keys).
     """
     if pos_encoding not in ("learned", "rope"):
         raise ValueError(f"pos_encoding={pos_encoding!r}")
@@ -112,7 +119,8 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     for i in range(num_layers):
         model.add(TransformerBlock(
             d_model, num_heads, ffn_mult, dropout,
-            sequence_parallel, rope=rope).set_name(f"block_{i}"))
+            sequence_parallel, rope=rope,
+            num_kv_heads=num_kv_heads).set_name(f"block_{i}"))
     model.add(nn.LayerNorm(d_model).set_name("final_norm"))
     model.add(nn.Linear(d_model, vocab_size,
                         init_method=init_mod.Xavier).set_name("lm_head"))
@@ -121,5 +129,6 @@ def TransformerLM(vocab_size: int, d_model: int = 128, num_heads: int = 4,
     # decode-path metadata (models/transformer/generate.py)
     model.lm_meta = {"num_layers": num_layers, "num_heads": num_heads,
                      "max_len": max_len, "d_model": d_model,
-                     "vocab": vocab_size, "pos_encoding": pos_encoding}
+                     "vocab": vocab_size, "pos_encoding": pos_encoding,
+                     "num_kv_heads": num_kv_heads}
     return model

@@ -25,6 +25,8 @@ def main(argv=None):
     parser.add_argument("--dropout", type=float, default=0.0)
     parser.add_argument("--posEncoding", default="learned",
                         choices=["learned", "rope"])
+    parser.add_argument("--numKvHeads", type=int, default=None,
+                        help="< numHeads selects grouped-query attention")
     parser.add_argument("--sequenceParallel", default=None,
                         choices=[None, "ring", "ulysses"])
     args = parser.parse_args(argv)
@@ -61,7 +63,8 @@ def main(argv=None):
                                 dropout=args.dropout,
                                 sequence_parallel=args.sequenceParallel,
                                 with_log_softmax=False,
-                                pos_encoding=args.posEncoding))
+                                pos_encoding=args.posEncoding,
+                                num_kv_heads=args.numKvHeads))
     if isinstance(model.modules[-1], nn.LogSoftMax):
         # legacy snapshot with a log-softmax head: CE(log_softmax(x)) ==
         # CE(x) exactly (logsumexp of log-probs is 0), but keeping the

@@ -90,7 +90,8 @@ def _transformer_perf(args):
                           num_heads=args.dModel // 128,
                           num_layers=args.numLayers,
                           max_len=s, with_log_softmax=False,
-                          pos_encoding=args.posEncoding)
+                          pos_encoding=args.posEncoding,
+                          num_kv_heads=args.numKvHeads)
     model.materialize(jax.random.PRNGKey(0))
     model.training()
     # CrossEntropyCriterion flattens (B, S, V) itself; wrapping it in
@@ -181,7 +182,9 @@ def _decode_perf(args):
     vocab, b = args.classNum, args.batchSize
     p_len, n_new = 512, 128
     model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
-                          max_len=p_len + n_new, with_log_softmax=False)
+                          max_len=p_len + n_new, with_log_softmax=False,
+                          pos_encoding=args.posEncoding,
+                          num_kv_heads=args.numKvHeads)
     model.materialize(jax.random.PRNGKey(0))
     model.evaluate()
     host = np.random.default_rng(0)
@@ -232,6 +235,8 @@ def main(argv=None):
     parser.add_argument("--posEncoding", default="learned",
                         choices=["learned", "rope"],
                         help="transformer position encoding")
+    parser.add_argument("--numKvHeads", type=int, default=None,
+                        help="< heads selects grouped-query attention")
     parser.add_argument("--numLayers", type=int, default=6,
                         help="transformer mode: layers")
     args = parser.parse_args(argv)

@@ -56,16 +56,27 @@ class MultiHeadAttention(Module):
     Composes with the sequence-parallel cores: rotation happens on the
     (GSPMD-sharded) global arrays before the collective, and positions
     are the global ``arange(S)``.
+
+    ``num_kv_heads`` < ``num_heads`` selects grouped-query attention
+    (GQA; num_kv_heads=1 is multi-query): k/v project to num_kv_heads
+    heads and are repeated across each query group before the core. The
+    parameter saving is in the k/v projections; the decode path's win is
+    the num_heads/num_kv_heads-times smaller KV cache
+    (models/transformer/generate.py).
     """
 
     def __init__(self, embed_dim: int, num_heads: int,
                  causal: bool = False, with_bias: bool = True,
                  sequence_parallel: str | None = None,
-                 mesh_axis: str = "seq", rope: bool = False):
+                 mesh_axis: str = "seq", rope: bool = False,
+                 num_kv_heads: int | None = None):
         super().__init__()
         assert embed_dim % num_heads == 0
         self.embed_dim, self.num_heads = embed_dim, num_heads
         self.head_dim = embed_dim // num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0, \
+            "num_heads must be a multiple of num_kv_heads"
         self.causal = causal
         self.with_bias = with_bias
         self.sequence_parallel = sequence_parallel
@@ -76,16 +87,17 @@ class MultiHeadAttention(Module):
 
     def init(self, rng):
         ks = jax.random.split(rng, 4)
+        kv_dim = self.num_kv_heads * self.head_dim
         p = {}
         for name, k in zip(("q", "k", "v", "out"), ks):
+            out_dim = kv_dim if name in ("k", "v") else self.embed_dim
             w = init_mod.init_weight(init_mod.Xavier, k,
-                                     (self.embed_dim, self.embed_dim),
+                                     (out_dim, self.embed_dim),
                                      fan_in=self.embed_dim,
-                                     fan_out=self.embed_dim)
+                                     fan_out=out_dim)
             p[f"{name}_weight"] = w
             if self.with_bias:
-                p[f"{name}_bias"] = jnp.zeros((self.embed_dim,),
-                                              default_dtype())
+                p[f"{name}_bias"] = jnp.zeros((out_dim,), default_dtype())
         return p
 
     def _proj(self, params, name, x):
@@ -100,12 +112,19 @@ class MultiHeadAttention(Module):
         b, s, e = x.shape
         heads = (self.num_heads, self.head_dim)
         q = self._proj(params, "q", x).reshape(b, s, *heads)
-        k = self._proj(params, "k", x).reshape(b, s, *heads)
-        v = self._proj(params, "v", x).reshape(b, s, *heads)
+        k = self._proj(params, "k", x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
+        v = self._proj(params, "v", x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
         if self.rope:
             pos = jnp.arange(s)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
+        if self.num_kv_heads != self.num_heads:
+            # GQA: each kv head serves num_heads/num_kv_heads query heads
+            group = self.num_heads // self.num_kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         if self.sequence_parallel == "ring":
             o = seq.ring_attention(q, k, v, causal=self.causal,
                                    axis=self.mesh_axis)

@@ -267,3 +267,41 @@ class TestRoPEDecoding:
         toks, _ = beam_search(m, prompt, num_beams=1, max_new_tokens=6)
         want = _oracle_greedy(m, prompt, 6)
         np.testing.assert_array_equal(np.asarray(toks)[:, 0], want)
+
+
+class TestGQADecoding:
+    """num_kv_heads < num_heads: the grouped-query KV cache decode must
+    stay token-exact with the growing-sequence forward."""
+
+    def _gqa_model(self, seed=0, kv=2, pos="learned"):
+        m = TransformerLM(VOCAB, d_model=D, num_heads=HEADS,
+                          num_layers=LAYERS, max_len=MAXLEN,
+                          num_kv_heads=kv, pos_encoding=pos)
+        m.materialize(jax.random.PRNGKey(seed))
+        m.evaluate()
+        return m
+
+    def test_gqa_greedy_matches_growing_forward(self):
+        m = self._gqa_model()
+        prompt = np.random.default_rng(9).integers(1, VOCAB + 1,
+                                                   size=(3, 7))
+        want = _oracle_greedy(m, prompt, 12)
+        got = np.asarray(generate(m, prompt, GenerationConfig(12)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_multiquery_rope_greedy_matches(self):
+        m = self._gqa_model(seed=1, kv=1, pos="rope")
+        prompt = np.random.default_rng(10).integers(1, VOCAB + 1,
+                                                    size=(2, 5))
+        want = _oracle_greedy(m, prompt, 8)
+        got = np.asarray(generate(m, prompt, GenerationConfig(8)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_gqa_beam_width1_matches_greedy(self):
+        from bigdl_tpu.models.transformer.generate import beam_search
+        m = self._gqa_model(seed=2)
+        prompt = np.random.default_rng(11).integers(1, VOCAB + 1,
+                                                    size=(2, 5))
+        toks, _ = beam_search(m, prompt, num_beams=1, max_new_tokens=6)
+        want = _oracle_greedy(m, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(toks)[:, 0], want)
