@@ -118,29 +118,22 @@ def _block_step(bp, x, ck, cv, pos, num_heads, max_len, rope=False,
     # what made batch-128 decode REGRESS below batch 64 (2 GB of
     # converts/step at B=128; round 3, docs/PERF.md)
     upto = start + jnp.arange(t)
-    if kv != num_heads:
-        # GQA: the cache stays at kv heads (the memory win); queries
-        # group as (B, T, kv, G, D) so no repeated kv materializes
-        g = num_heads // kv
-        b_, t_ = x.shape[0], t
-        hd = q.shape[-1]
-        qg = q.reshape(b_, t_, kv, g, hd)
-        s = jnp.einsum("btkgd,bmkd->bkgtm", qg.astype(ck.dtype), ck,
-                       preferred_element_type=jnp.float32) * scale
-        kpos = jnp.arange(max_len)[None, None, None, None, :]
-        s = jnp.where(kpos > upto[None, None, None, :, None], -1e9, s)
-        o = jnp.einsum("bkgtm,bmkd->btkgd",
-                       jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
-                       preferred_element_type=jnp.float32)
-        o = o.reshape(b_, t_, num_heads, hd).astype(x.dtype)
-    else:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(ck.dtype), ck,
-                       preferred_element_type=jnp.float32) * scale
-        kpos = jnp.arange(max_len)[None, None, None, :]
-        s = jnp.where(kpos > upto[None, None, :, None], -1e9, s)
-        o = jnp.einsum("bhqk,bkhd->bqhd",
-                       jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+    # one grouped path (g == 1 IS plain MHA: the (kv, g) reshape is
+    # free): the cache stays at kv heads — the GQA memory/bandwidth win
+    # — and queries group as (B, T, kv, G, D) so no repeated kv ever
+    # materializes. Operands stay in the cache dtype with f32
+    # ACCUMULATION (see the note above).
+    g = num_heads // kv
+    b_, hd = x.shape[0], q.shape[-1]
+    qg = q.reshape(b_, t, kv, g, hd)
+    s = jnp.einsum("btkgd,bmkd->bkgtm", qg.astype(ck.dtype), ck,
+                   preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(max_len)[None, None, None, None, :]
+    s = jnp.where(kpos > upto[None, None, None, :, None], -1e9, s)
+    o = jnp.einsum("bkgtm,bmkd->btkgd",
+                   jax.nn.softmax(s, axis=-1).astype(cv.dtype), cv,
+                   preferred_element_type=jnp.float32)
+    o = o.reshape(b_, t, num_heads, hd).astype(x.dtype)
     o = _proj(mha_p, "out",
               o.reshape(x.shape)).astype(activation_dtype())
     x = x + o

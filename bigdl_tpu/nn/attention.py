@@ -74,6 +74,9 @@ class MultiHeadAttention(Module):
         assert embed_dim % num_heads == 0
         self.embed_dim, self.num_heads = embed_dim, num_heads
         self.head_dim = embed_dim // num_heads
+        if num_kv_heads is not None and num_kv_heads < 1:
+            raise ValueError(f"num_kv_heads={num_kv_heads} must be >= 1 "
+                             "(or None for full MHA)")
         self.num_kv_heads = num_kv_heads or num_heads
         assert num_heads % self.num_kv_heads == 0, \
             "num_heads must be a multiple of num_kv_heads"
@@ -120,14 +123,18 @@ class MultiHeadAttention(Module):
             pos = jnp.arange(s)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
-        if self.num_kv_heads != self.num_heads:
-            # GQA: each kv head serves num_heads/num_kv_heads query heads
-            group = self.num_heads // self.num_kv_heads
+        group = self.num_heads // self.num_kv_heads
+        if group > 1 and self.sequence_parallel != "ring":
+            # GQA: each kv head serves `group` query heads. The ring core
+            # takes the narrow k/v and widens per hop INSIDE the ring, so
+            # grouped blocks travel the ICI at kv width (review finding);
+            # the local/Ulysses cores need full-width heads here (the
+            # flash kernel and the Ulysses head-split assume H match)
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
         if self.sequence_parallel == "ring":
             o = seq.ring_attention(q, k, v, causal=self.causal,
-                                   axis=self.mesh_axis)
+                                   axis=self.mesh_axis, kv_groups=group)
         elif self.sequence_parallel == "ulysses":
             o = seq.ulysses_attention(q, k, v, causal=self.causal,
                                       axis=self.mesh_axis)

@@ -103,7 +103,8 @@ def _merge_blocks(o, lse, o_t, lse_t):
     return o_new, m_safe + jnp.log(d_safe)
 
 
-def _ring_body_flash(q, k, v, *, axis, n, causal, scale, interpret):
+def _ring_body_flash(q, k, v, *, axis, n, causal, scale, interpret,
+                     kv_groups=1):
     """Ring attention whose per-step local attention is the fused Pallas
     flash kernel: each rotating K/V block contributes (o_t, lse_t) and the
     shards merge by logsumexp. Per-chip live memory is O(S_local * D) —
@@ -137,12 +138,17 @@ def _ring_body_flash(q, k, v, *, axis, n, causal, scale, interpret):
 
     for t in range(n):
         src = (idx + t) % n                      # global block id of k/v
+        # GQA: narrow (kv-head) blocks ride the ring; widen to the query
+        # head count only for the local attention math (review finding:
+        # a pre-ring repeat multiplied ring bytes by the group factor)
+        ke = jnp.repeat(k, kv_groups, axis=2) if kv_groups > 1 else k
+        ve = jnp.repeat(v, kv_groups, axis=2) if kv_groups > 1 else v
         if causal:
             case = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
             o_t, lse_t = jax.lax.switch(case, (full_fn, diag_fn, skip_fn),
-                                        q, k, v)
+                                        q, ke, ve)
         else:
-            o_t, lse_t = full_fn(q, k, v)
+            o_t, lse_t = full_fn(q, ke, ve)
         o, lse = _merge_blocks(o, lse, o_t, lse_t)
         if t != n - 1:
             k = jax.lax.ppermute(k, axis, perm)
@@ -150,7 +156,7 @@ def _ring_body_flash(q, k, v, *, axis, n, causal, scale, interpret):
     return o.astype(q.dtype)
 
 
-def _ring_body(q, k, v, *, axis, n, causal, scale):
+def _ring_body(q, k, v, *, axis, n, causal, scale, kv_groups=1):
     """Per-shard ring attention: local q block, rotating k/v blocks."""
     f32 = jnp.float32
     b, sq, h, d = q.shape
@@ -165,7 +171,9 @@ def _ring_body(q, k, v, *, axis, n, causal, scale):
 
     for t in range(n):
         src = (idx + t) % n                      # global block id of k/v
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(f32))
+        ke = jnp.repeat(k, kv_groups, axis=2) if kv_groups > 1 else k
+        v_use = jnp.repeat(v, kv_groups, axis=2) if kv_groups > 1 else v
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ke.astype(f32))
         if causal:
             qpos = idx * sq + jnp.arange(sq)[:, None]
             kpos = src * skv + jnp.arange(skv)[None, :]
@@ -176,7 +184,7 @@ def _ring_body(q, k, v, *, axis, n, causal, scale):
         p = jnp.exp(s - m_new[..., None])
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * jnp.moveaxis(corr, 1, 2)[..., None] \
-            + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(f32))
+            + jnp.einsum("bhqk,bkhd->bqhd", p, v_use.astype(f32))
         m = m_new
         if t != n - 1:
             k = jax.lax.ppermute(k, axis, perm)
@@ -228,7 +236,8 @@ def _flash_ring_ok(q, k, q_local, kv_local, causal, flash,
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: float | None = None, axis: str = "seq",
                    mesh: Mesh | None = None, batch_axis="auto",
-                   flash: str | bool = "auto", interpret: bool = False):
+                   flash: str | bool = "auto", interpret: bool = False,
+                   kv_groups: int = 1):
     """Sequence-parallel attention; q/k/v sharded on dim 1 over ``axis``.
 
     Call eagerly with global arrays (this wrapper shards them) or use
@@ -254,9 +263,10 @@ def ring_attention(q, k, v, *, causal: bool = False,
         if use_flash:
             return _ring_body_flash(qb, kb, vb, axis=axis, n=n,
                                     causal=causal, scale=scale,
-                                    interpret=interpret)
+                                    interpret=interpret,
+                                    kv_groups=kv_groups)
         return _ring_body(qb, kb, vb, axis=axis, n=n, causal=causal,
-                          scale=scale)
+                          scale=scale, kv_groups=kv_groups)
 
     spec = _qkv_spec(mesh, axis, batch_axis)
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
@@ -267,7 +277,7 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
                            scale: float | None = None, axis: str = "seq",
                            axis_size: int | None = None,
                            flash: str | bool = "auto",
-                           interpret: bool = False):
+                           interpret: bool = False, kv_groups: int = 1):
     """The per-shard ring computation, for use INSIDE shard_map/pjit where
     ``q``/``k``/``v`` are already the local sequence blocks."""
     n = axis_size if axis_size is not None else jax.lax.axis_size(axis)
@@ -275,8 +285,10 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
     if _flash_ring_ok(q, k, q.shape[1], k.shape[1], causal, flash,
                       interpret):
         return _ring_body_flash(q, k, v, axis=axis, n=n, causal=causal,
-                                scale=scale, interpret=interpret)
-    return _ring_body(q, k, v, axis=axis, n=n, causal=causal, scale=scale)
+                                scale=scale, interpret=interpret,
+                                kv_groups=kv_groups)
+    return _ring_body(q, k, v, axis=axis, n=n, causal=causal, scale=scale,
+                      kv_groups=kv_groups)
 
 
 def ulysses_attention(q, k, v, *, causal: bool = False,

@@ -259,3 +259,30 @@ class TestGQA:
             params, ostate, loss = step(params, ostate)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.8
+
+    def test_gqa_ring_matches_local(self):
+        """Grouped k/v blocks ride the ring at kv width (widened only
+        inside each hop) and must match the local grouped attention."""
+        from bigdl_tpu.parallel import Engine
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 4}, devices=jax.devices()[:4])
+        rs = np.random.default_rng(2)
+        x = jnp.asarray(rs.standard_normal((2, 16, 32)), jnp.float32)
+        local = nn.MultiHeadAttention(32, 4, causal=True, num_kv_heads=2,
+                                      rope=True)
+        local.materialize(jax.random.PRNGKey(0))
+        ring = nn.MultiHeadAttention(32, 4, causal=True, num_kv_heads=2,
+                                     rope=True, sequence_parallel="ring")
+        want, _ = local.apply(local.params, {}, x)
+        xs = jax.device_put(x, NamedSharding(mesh, P(None, "seq")))
+        with mesh:
+            got, _ = ring.apply(local.params, {}, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+        Engine.reset()
+
+    def test_bad_num_kv_heads_raises(self):
+        import pytest as _pt
+        with _pt.raises(ValueError, match="num_kv_heads"):
+            nn.MultiHeadAttention(32, 4, num_kv_heads=0)
