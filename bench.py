@@ -449,6 +449,44 @@ def bench_transformer_lm(b: int = 4, s: int = 2048, vocab: int = 32768,
     return out
 
 
+def bench_decode(b: int = 128, kv_heads: int | None = 1,
+                 iters: int = 30):
+    """KV-cache decode throughput: 27M LM, prompt 512, +128 greedy
+    tokens. ``kv_heads=1`` is the multi-query config (docs/PERF.md round
+    4: the cache was the decode bound; MQA runs 4.1x MHA)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                       generate)
+
+    _set_bf16_policy()
+    vocab, p_len, n_new = 8192, 512, 128
+    model = TransformerLM(vocab, d_model=512, num_heads=4, num_layers=6,
+                          max_len=p_len + n_new, with_log_softmax=False,
+                          num_kv_heads=kv_heads)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    host = np.random.default_rng(0)
+    prompt = jnp.asarray(host.integers(1, vocab + 1, size=(b, p_len)))
+    cfg = GenerationConfig(n_new)
+    out = generate(model, prompt, cfg)          # compile + warm
+    np.asarray(out)        # REAL sync (block_until_ready is a tunnel no-op)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = generate(model, prompt, cfg)
+    int(np.asarray(out)[0, 0])                  # real sync
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
+        "value": round(b * n_new * iters / dt, 1),
+        "unit": "tokens/sec/chip",
+        "geometry": f"27M d512 L6 B{b} prompt{p_len} +{n_new} "
+                    f"kv_heads={kv_heads or 4}",
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--headline-only", action="store_true")
@@ -466,10 +504,10 @@ def main(argv=None):
             else [r.strip() for r in args.rows.split(",")])
     if args.rows == "all" and not args.headline_only:
         rows = ["headline", "real", "real_cached", "resnet50", "vgg16",
-                "transformer"]
+                "transformer", "decode"]
 
     known = {"headline", "real", "real_cached", "resnet50", "vgg16",
-             "transformer"}
+             "transformer", "decode"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -487,6 +525,8 @@ def main(argv=None):
                 _emit(bench_convnet_synthetic(row))
             elif row == "transformer":
                 _emit(bench_transformer_lm())
+            elif row == "decode":
+                _emit(bench_decode())
         except Exception as e:   # a broken extra row must not kill the
             if row == "headline":     # headline contract
                 raise
