@@ -6,10 +6,12 @@ trains in lockstep over the 8-device global mesh — the DCN code path
 (distri_optimizer._shard_batch multi-process branch).
 
 Usage: python multihost_worker.py <process_id> <num_processes> <port> [mode]
-``mode``: "dp" (default, pure data parallel) or "dp_tp" (a {"data": 4,
+``mode``: "dp" (default, pure data parallel), "dp_tp" (a {"data": 4,
 "model": 2} mesh with GSPMD tensor-parallel params — the composed-axes
 path ACROSS PROCESSES; TP is layout-only so losses still match the
-single-process control).
+single-process control), or "u8:<shard_dir>" (each process decodes its
+own .brec shards through the native u8 pipeline and the in-step device
+normalize — the production ImageNet input path across processes).
 Prints one line: ``LOSSES <pid> <json list>``.
 """
 import json
@@ -66,6 +68,31 @@ def main():
     logger = logging.getLogger("bigdl_tpu.optim")
     logger.addHandler(Rec())
     logger.setLevel(logging.INFO)
+
+    if mode.startswith("u8:"):
+        from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
+        from bigdl_tpu.dataset.recordio import RecordShardDataSet
+        shard_dir = mode[3:]
+        rds = RecordShardDataSet(shard_dir,
+                                 process_index=jax.process_index(),
+                                 process_count=nproc)
+        batcher = NativeBRecToBatch(
+            8, 24, 24, train=True, mean_rgb=(0.485, 0.456, 0.406),
+            std_rgb=(0.229, 0.224, 0.225), device_normalize=True)
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3, 2, 2), nn.ReLU(),
+            nn.Reshape([4 * 11 * 11]), nn.Linear(4 * 11 * 11, 4))
+        model.materialize(jax.random.PRNGKey(0))
+        Engine.reset()
+        mesh = Engine.init()
+        o = optim.Optimizer(model=model, dataset=rds >> batcher,
+                            criterion=nn.ClassNLLCriterion(), mesh=mesh)
+        o.set_input_transform(batcher.device_transform())
+        o.set_optim_method(optim.SGD(learning_rate=0.05))
+        o.set_end_when(optim.max_iteration(4))
+        o.optimize()
+        print(f"LOSSES {pid} {json.dumps(losses)}", flush=True)
+        return
 
     model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
                           nn.LogSoftMax())

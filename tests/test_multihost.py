@@ -151,3 +151,61 @@ def test_two_process_dp_tp_matches_single_process():
     np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
     control = _single_process_control()
     np.testing.assert_allclose(losses[0], control, rtol=1e-4)
+
+
+def test_two_process_u8_shard_pipeline(tmp_path):
+    """The production ImageNet input path across processes (round-4
+    suggestion #2): each process reads its own .brec shards, decodes
+    through the native u8 pipeline, normalizes in-step on device, and
+    the two processes train one global batch in lockstep."""
+    import io
+
+    from PIL import Image
+
+    from bigdl_tpu import native
+    from bigdl_tpu.dataset.recordio import RecordWriter
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rs = np.random.RandomState(3)
+    for s in range(2):
+        with RecordWriter(str(tmp_path / f"s{s}.brec")) as w:
+            for i in range(32):
+                arr = rs.randint(0, 256, (36, 36, 3)).astype(np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, "JPEG", quality=92)
+                w.write(buf.getvalue(), float(i % 4 + 1))
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(WORKER), str(pid), "2", str(port),
+         f"u8:{tmp_path}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost u8 worker timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        if rc != 0 and ("DISTRIBUTED" in err.upper()
+                        or "gloo" in err.lower()
+                        or "coordinator" in err.lower()):
+            pytest.skip(f"jax.distributed unavailable here: {err[-400:]}")
+        assert rc == 0, f"worker failed:\n{err[-2000:]}"
+    losses = {}
+    for rc, out, err in outs:
+        for line in out.splitlines():
+            if line.startswith("LOSSES "):
+                _, pid, payload = line.split(" ", 2)
+                losses[int(pid)] = json.loads(payload)
+    assert set(losses) == {0, 1}
+    assert len(losses[0]) == 4
+    assert all(np.isfinite(losses[0]))
+    # lockstep: both processes observe the identical global computation
+    np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
