@@ -42,20 +42,6 @@ def main():
     from bigdl_tpu.utils.random import RandomGenerator
 
     RandomGenerator.set_seed(9)
-    rs = np.random.RandomState(0)
-    x = rs.rand(64, 2).astype(np.float32)
-    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
-    samples = [Sample(x[i], y[i]) for i in range(64)]
-
-    sharded = ShardedDataSet(samples, num_shards=nproc,
-                             shard_index=jax.process_index())
-    # pin the per-pass rotation so the global sample set per step matches
-    # the single-process control exactly
-    sharded._pass_offset = lambda k: 0
-    # global batch 16 -> 4 batches/epoch: all compared iterations stay in
-    # epoch 1 (epoch-end shuffles are per-shard, like the reference's
-    # per-partition shuffle, so they can't match a single-process control)
-    ds = sharded >> SampleToBatch(16 // nproc, drop_remainder=True)
 
     losses = []
 
@@ -93,6 +79,21 @@ def main():
         o.optimize()
         print(f"LOSSES {pid} {json.dumps(losses)}", flush=True)
         return
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+
+    sharded = ShardedDataSet(samples, num_shards=nproc,
+                             shard_index=jax.process_index())
+    # pin the per-pass rotation so the global sample set per step matches
+    # the single-process control exactly
+    sharded._pass_offset = lambda k: 0
+    # global batch 16 -> 4 batches/epoch: all compared iterations stay in
+    # epoch 1 (epoch-end shuffles are per-shard, like the reference's
+    # per-partition shuffle, so they can't match a single-process control)
+    ds = sharded >> SampleToBatch(16 // nproc, drop_remainder=True)
 
     model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
                           nn.LogSoftMax())
