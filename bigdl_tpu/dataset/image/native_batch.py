@@ -11,6 +11,7 @@ per-thread generators differ from single-threaded order).
 """
 from __future__ import annotations
 
+import hashlib
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -130,11 +131,14 @@ class NativeBRecToBatch(Transformer):
             batch, status = run(all_idx)
         else:
             # stable record identity when the source provides one
-            # (read_records tags (shard, index)); hashing the payload is
-            # the fallback — and measurably worse: SipHash over every
-            # record's JPEG bytes each epoch costs ~25-50 ms per
-            # 256-batch on the 1-core host (review finding)
-            keys = [r.key if r.key is not None else hash(r.data)
+            # (read_records tags (shard, index)); digesting the payload is
+            # the fallback — and measurably worse (~tens of ms per
+            # 256-batch on the 1-core host), so sources should tag keys.
+            # blake2b-128, not hash(): a 64-bit SipHash collision between
+            # two JPEGs would silently serve the wrong cached image for
+            # the rest of training (advisor finding, r4)
+            keys = [r.key if r.key is not None
+                    else hashlib.blake2b(r.data, digest_size=16).digest()
                     for r in records]
             hit = np.asarray([i for i in all_idx
                               if keys[i] in self._cache], np.int64)

@@ -22,7 +22,8 @@ def apply_rope(x, positions, theta: float = 10000.0):
     """Rotary position embedding over the head dim (GPT-NeoX split-half
     convention: pairs are (x[..., i], x[..., i + D/2])).
 
-    ``x``: (..., S, H, D) with D even; ``positions``: (S,) absolute token
+    ``x``: (..., S, H, D) with D even (any number of leading batch dims);
+    ``positions``: (S,) absolute token
     positions (int). Rotation depends only on a token's own absolute
     position, so scores q_m . k_n depend only on m - n (pinned by
     tests/test_transformer.py) — the property that lets a KV cache store
@@ -35,8 +36,11 @@ def apply_rope(x, positions, theta: float = 10000.0):
     # angles in f32 (bf16 positions would alias beyond ~256), the
     # rotation itself in x's dtype — the f32 variant cost ~8 ms/step on
     # the d1024/12L flagship (24 widened elementwise passes)
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    # broadcast shape built from x.ndim so any number of leading batch
+    # dims aligns (S, hf) onto x's (S, ..., D/2) axes, not a hard-coded 4-D
+    bshape = (1,) * (x.ndim - 3) + (ang.shape[0], 1, half)
+    cos = jnp.cos(ang).reshape(bshape).astype(x.dtype)
+    sin = jnp.sin(ang).reshape(bshape).astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x2 * cos + x1 * sin], axis=-1)
@@ -78,8 +82,9 @@ class MultiHeadAttention(Module):
             raise ValueError(f"num_kv_heads={num_kv_heads} must be >= 1 "
                              "(or None for full MHA)")
         self.num_kv_heads = num_kv_heads or num_heads
-        assert num_heads % self.num_kv_heads == 0, \
-            "num_heads must be a multiple of num_kv_heads"
+        if num_heads % self.num_kv_heads != 0:
+            raise ValueError(f"num_heads={num_heads} must be a multiple "
+                             f"of num_kv_heads={self.num_kv_heads}")
         self.causal = causal
         self.with_bias = with_bias
         self.sequence_parallel = sequence_parallel

@@ -328,10 +328,17 @@ class Container(Module):
         return self
 
     def get_times(self):
-        # the container's own row first (its facade forward/backward time
-        # covers the whole jit-compiled chain; children accumulate only
-        # when individually forwarded — see Module.get_times)
-        out = [(self, self.forward_time, self.backward_time)]
+        """Timing rows: the container's own row first, then children.
+
+        DEVIATION from reference Container.getTimes (Container.scala:71-73,
+        children only): under jit the container facade's forward time covers
+        the whole compiled chain while children read zero, so the self row
+        is the only signal in the common path. It is emitted only when
+        nonzero, and a summing aggregator that also forwards children
+        individually should filter rows with ``isinstance(m, Container)``
+        to avoid double counting."""
+        out = ([(self, self.forward_time, self.backward_time)]
+               if (self.forward_time or self.backward_time) else [])
         for m in self.modules:
             out.extend(m.get_times())
         return out

@@ -232,6 +232,7 @@ void process_one_u8(const uint8_t* data, size_t size, int crop_h,
     }
     scratch.resize(static_cast<size_t>(cinfo.output_width) * 3);
     const int xrel = x0 - static_cast<int>(xoff);
+    int rows_done = 0;
     for (int y = 0; y < crop_h;) {
       JSAMPROW row = scratch.data();
       const int got = static_cast<int>(jpeg_read_scanlines(&cinfo, &row, 1));
@@ -239,7 +240,14 @@ void process_one_u8(const uint8_t* data, size_t size, int crop_h,
       copy_row_u8(scratch.data() + static_cast<size_t>(xrel) * 3, crop_w,
                   crop_w, flip, out + static_cast<size_t>(y) * row_bytes);
       ++y;
+      rows_done = y;
     }
+    // a truncated stream can end the row loop early; zero the tail so a
+    // "success" status never reports uninitialized pixels (mirrors the
+    // full-decode path's undersized-copy memset)
+    if (rows_done < crop_h)
+      std::memset(out + static_cast<size_t>(rows_done) * row_bytes, 0,
+                  static_cast<size_t>(crop_h - rows_done) * row_bytes);
     jpeg_abort_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
     *status = 0;
