@@ -3,7 +3,11 @@
 Reference parity: optim/Metrics.scala:24-117 — named counters in local /
 aggregate / per-node-distributed scopes, dumped via ``summary()``. The Spark
 accumulator scopes collapse to host-side counters here (one process per
-host in the TPU runtime).
+host in the TPU runtime); the reference's cross-node accumulator scope
+(Metrics.scala:24-27 accumulableCollection) is provided by
+:meth:`Metrics.aggregated`, a collective merge of every process's counters
+over the jax.distributed job — call it (on all hosts) when the operator
+needs the all-hosts view instead of the local one.
 
 Honest phase naming: the reference's per-iteration phases ("get weights
 average", "computing time for each node", "aggregate gradient time") don't
@@ -73,6 +77,61 @@ class Metrics:
                 "p50": float(np.percentile(vals, 50)),
                 "p95": float(np.percentile(vals, 95)),
                 "max": float(vals.max())}
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {"scalars": dict(self._scalars),
+                    "counts": dict(self._counts),
+                    "distributed": {k: list(v)
+                                    for k, v in self._distributed.items()},
+                    "series": {k: list(v) for k, v in self._series.items()}}
+
+    def _merge_snapshot(self, snap: dict) -> None:
+        with self._lock:
+            for k, v in snap["scalars"].items():
+                if snap["counts"].get(k, 0) > 0:    # add()-accumulated: sum
+                    self._scalars[k] = self._scalars.get(k, 0.0) + v
+                    self._counts[k] += snap["counts"][k]
+                elif k not in self._scalars:        # set(): first host wins
+                    self._scalars[k] = v
+            for k, v in snap["distributed"].items():
+                self._distributed.setdefault(k, []).extend(v)
+            for k, v in snap["series"].items():
+                if k not in self._series:
+                    self._series[k] = deque(maxlen=self._keep)
+                self._series[k].extend(v)
+
+    def aggregated(self) -> "Metrics":
+        """Cross-host merge (reference Metrics distributed scope,
+        Metrics.scala:24-27,96-108): every process contributes its
+        counters and the returned Metrics reflects ALL hosts —
+        add()-accumulators sum, series concatenate in process order,
+        set() scalars take the first host's value. COLLECTIVE: every
+        process of the jax.distributed job must call this at the same
+        point (it rides a device all-gather). Single-process it is a
+        plain copy. The originals are left untouched."""
+        import pickle
+
+        import jax
+        import numpy as np
+
+        out = Metrics(keep=self._keep * max(1, jax.process_count()))
+        if jax.process_count() == 1:
+            out._merge_snapshot(self._snapshot())
+            return out
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(pickle.dumps(self._snapshot()), np.uint8)
+        # snapshots differ in size per host: gather lengths, pad, gather
+        sizes = multihost_utils.process_allgather(
+            np.asarray([payload.size], np.int64))
+        buf = np.zeros(int(sizes.max()), np.uint8)
+        buf[:payload.size] = payload
+        bufs = multihost_utils.process_allgather(buf)
+        for p in range(bufs.shape[0]):
+            out._merge_snapshot(pickle.loads(
+                bufs[p, :int(sizes[p])].tobytes()))
+        return out
 
     def summary(self, unit: str = "s", scale: float = 1.0) -> str:
         """(reference Metrics.summary, Metrics.scala:96-108) — scalar means

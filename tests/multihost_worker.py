@@ -1,18 +1,34 @@
-"""Worker script for the 2-process multi-host test (NOT a pytest module).
+"""Worker script for the multi-process multi-host tests (NOT a pytest
+module).
 
-Each process owns 4 virtual CPU devices and one data shard; DistriOptimizer
-assembles global batches via jax.make_array_from_process_local_data and
-trains in lockstep over the 8-device global mesh — the DCN code path
+Each process owns ``8 // num_processes`` virtual CPU devices and one data
+shard; DistriOptimizer assembles global batches via
+jax.make_array_from_process_local_data and trains in lockstep over the
+8-device global mesh — the DCN code path
 (distri_optimizer._shard_batch multi-process branch).
 
 Usage: python multihost_worker.py <process_id> <num_processes> <port> [mode]
-``mode``: "dp" (default, pure data parallel), "dp_tp" (a {"data": 4,
-"model": 2} mesh with GSPMD tensor-parallel params — the composed-axes
-path ACROSS PROCESSES; TP is layout-only so losses still match the
-single-process control), or "u8:<shard_dir>" (each process decodes its
-own .brec shards through the native u8 pipeline and the in-step device
-normalize — the production ImageNet input path across processes).
-Prints one line: ``LOSSES <pid> <json list>``.
+``mode``:
+- "dp" (default): pure data parallel; also prints an aggregated
+  cross-host metrics line (``Metrics.aggregated``).
+- "dp_tp": a {"data": 4, "model": 2} mesh with GSPMD tensor-parallel
+  params — the composed-axes path ACROSS PROCESSES; TP is layout-only so
+  losses still match the single-process control.
+- "dp_pp": GPipe pipeline stages on a 'model' axis composed with a
+  'data' axis, both spanning processes (``dp_pp_losses`` below — the
+  test imports it for the single-process control).
+- "u8:<shard_dir>": each process decodes its own .brec shards through
+  the native u8 pipeline and the in-step device normalize — the
+  production ImageNet input path across processes.
+- "ckpt:<dir>" / "ckpt_tp:<dir>": train 3 iterations, checkpointing at
+  iteration 3 into <dir>/p<pid> (host-local disk semantics); the _tp
+  variant saves GSPMD-sharded params, which ``file._to_host``
+  re-assembles into global arrays via a process allgather.
+- "resume:<dir>" / "resume_tp:<dir>": load <dir>/p<pid> snapshot 3 and
+  train to iteration 4 — the kill/resume path; the _tp variant re-shards
+  the loaded global params over the mesh.
+Prints one line ``LOSSES <pid> <json list>`` (+ ``METRICS <pid> <json>``
+in dp mode).
 """
 import json
 import logging
@@ -20,11 +36,64 @@ import os
 import sys
 
 
+def dp_pp_losses(mesh, steps=4, nproc=1, pid=0):
+    """dp x pp trajectory, identical code for workers and the
+    single-process control: 4 stacked tanh layers pipelined over the
+    'model' axis (2 stages x 2 microbatches), batch sharded over 'data',
+    plain SGD. Deterministic data from RandomState(0); multi-process
+    callers pass their contiguous local slice of the global batch through
+    make_array_from_process_local_data."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.parallel.pipeline import (pipeline_apply,
+                                             stack_layer_params)
+
+    rs = np.random.RandomState(0)
+    gx = rs.rand(16, 16).astype(np.float32)
+    gt = rs.rand(16, 16).astype(np.float32)
+    layers = [{"w": ((rs.rand(16, 16) - 0.5) / 4.0).astype(np.float32)}
+              for _ in range(4)]
+    sp = jax.tree.map(jnp.asarray, stack_layer_params(layers))
+    sharding = NamedSharding(mesh, P("data", None))
+    if nproc > 1:
+        lo = pid * 16 // nproc
+        hi = (pid + 1) * 16 // nproc
+        xg = jax.make_array_from_process_local_data(sharding, gx[lo:hi])
+        tg = jax.make_array_from_process_local_data(sharding, gt[lo:hi])
+    else:
+        xg = jax.device_put(jnp.asarray(gx), sharding)
+        tg = jax.device_put(jnp.asarray(gt), sharding)
+
+    def layer_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    @jax.jit
+    def step(sp, xg, tg):
+        # xg/tg passed as args: a multihost global array may not be
+        # CLOSED OVER by a jitted fn (non-addressable shards)
+        def loss(sp):
+            y = pipeline_apply(layer_fn, sp, xg, num_microbatches=2,
+                               mesh=mesh, data_axis="data")
+            return jnp.mean((y - tg) ** 2)
+        l, g = jax.value_and_grad(loss)(sp)
+        return l, jax.tree.map(lambda w, gw: w - 0.2 * gw, sp, g)
+
+    losses = []
+    for _ in range(steps):
+        l, sp = step(sp, xg, tg)
+        losses.append(float(l))
+    return losses
+
+
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                               f"{max(1, 8 // nproc)}")
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=f"localhost:{port}",
@@ -55,6 +124,13 @@ def main():
     logger.addHandler(Rec())
     logger.setLevel(logging.INFO)
 
+    if mode == "dp_pp":
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 4, "model": 2})
+        pls = dp_pp_losses(mesh, steps=4, nproc=nproc, pid=pid)
+        print(f"LOSSES {pid} {json.dumps(pls)}", flush=True)
+        return
+
     if mode.startswith("u8:"):
         from bigdl_tpu.dataset.image.native_batch import NativeBRecToBatch
         from bigdl_tpu.dataset.recordio import RecordShardDataSet
@@ -80,6 +156,18 @@ def main():
         print(f"LOSSES {pid} {json.dumps(losses)}", flush=True)
         return
 
+    # --- dp / dp_tp / ckpt[_tp] / resume[_tp] over the XOR sample set ----
+    ckpt_dir = resume_dir = None
+    tensor_parallel = False
+    base = mode
+    if ":" in mode:
+        base, arg = mode.split(":", 1)
+        if base in ("ckpt", "ckpt_tp"):
+            ckpt_dir = os.path.join(arg, f"p{pid}")
+        elif base in ("resume", "resume_tp"):
+            resume_dir = os.path.join(arg, f"p{pid}")
+    tensor_parallel = base.endswith("_tp")
+
     rs = np.random.RandomState(0)
     x = rs.rand(64, 2).astype(np.float32)
     y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
@@ -90,15 +178,21 @@ def main():
     # pin the per-pass rotation so the global sample set per step matches
     # the single-process control exactly
     sharded._pass_offset = lambda k: 0
-    # global batch 16 -> 4 batches/epoch: all compared iterations stay in
-    # epoch 1 (epoch-end shuffles are per-shard, like the reference's
-    # per-partition shuffle, so they can't match a single-process control)
+    # global batch 16: all compared iterations stay in epoch 1 (epoch-end
+    # shuffles are per-shard, like the reference's per-partition shuffle,
+    # so they can't match a single-process control)
     ds = sharded >> SampleToBatch(16 // nproc, drop_remainder=True)
 
-    model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
-                          nn.LogSoftMax())
+    if resume_dir is not None:
+        from bigdl_tpu.utils import file as bfile
+        model = bfile.load_module(f"{resume_dir}/model.3")
+        state = bfile.load(f"{resume_dir}/state.3")
+    else:
+        model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(),
+                              nn.Linear(16, 2), nn.LogSoftMax())
+        state = None
     Engine.reset()
-    if mode == "dp_tp":
+    if tensor_parallel:
         mesh = Engine.init(axes={"data": 4, "model": 2})
         o = optim.Optimizer(model=model, dataset=ds,
                             criterion=nn.ClassNLLCriterion(), mesh=mesh,
@@ -108,9 +202,21 @@ def main():
         o = optim.Optimizer(model=model, dataset=ds,
                             criterion=nn.ClassNLLCriterion(), mesh=mesh)
     o.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
-    o.set_end_when(optim.max_iteration(4))
+    if state is not None:
+        o.set_state(state)
+    if ckpt_dir is not None:
+        o.set_checkpoint(ckpt_dir, optim.several_iteration(3))
+        o.set_end_when(optim.max_iteration(3))
+    else:        # plain runs and resumes both stop at iteration 4
+        o.set_end_when(optim.max_iteration(4))
     o.optimize()
     print(f"LOSSES {pid} {json.dumps(losses)}", flush=True)
+    if base == "dp":
+        # cross-host metrics merge (reference Metrics.scala accumulator
+        # scope): every host's summary must reflect ALL hosts
+        agg = o.metrics.aggregated()
+        print(f"METRICS {pid} "
+              f"{json.dumps(agg.stats('device step time'))}", flush=True)
 
 
 if __name__ == "__main__":
