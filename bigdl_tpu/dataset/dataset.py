@@ -49,6 +49,13 @@ class AbstractDataSet:
         data-parallel ShardedDataSet — drives Optimizer factory dispatch."""
         return False
 
+    def process_shard_count(self):
+        """Number of process shards this dataset was built for (through
+        transforms), or None when unknown. Multi-host validation guards
+        compare it against jax.process_count() to refuse double-counting
+        setups."""
+        return None
+
     def get_position_state(self):
         """Checkpointable pipeline position (shuffle permutation etc.);
         None when the source has no such state. Paired with
@@ -79,6 +86,9 @@ class TransformedDataSet(AbstractDataSet):
 
     def is_sharded(self):
         return self.base.is_sharded()
+
+    def process_shard_count(self):
+        return self.base.process_shard_count()
 
     def get_position_state(self):
         return self.base.get_position_state()
@@ -187,6 +197,9 @@ class ShardedDataSet(PassRotationMixin, AbstractDataSet):
         self._seed_shard = shard_index
         self._local = self._all[shard_index::num_shards]
         self._index = np.arange(len(self._local))
+
+    def process_shard_count(self):
+        return self.num_shards
 
     def is_sharded(self):
         return True

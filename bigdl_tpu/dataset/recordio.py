@@ -229,6 +229,9 @@ class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
     def is_sharded(self):
         return self.process_count > 1
 
+    def process_shard_count(self):
+        return self.process_count
+
     def size(self) -> int:
         """Global record count (reference DistributedDataSet.size)."""
         return sum(self._count(p) for p in self._all_paths)

@@ -209,22 +209,34 @@ class DistriOptimizer(Optimizer):
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 
-        jit_eval = jax.jit(eval_apply, in_shardings=(param_shard, repl,
-                                                     batch_shard),
-                           out_shardings=batch_shard)
+        if jax.process_count() > 1:
+            # multi-host in-training validation: per-process shards can't
+            # be device_put onto the global mesh (round-5 review finding:
+            # that raised before the cross-host reduce was ever reached).
+            # Each process evaluates its own shard on its LOCAL devices
+            # with the host-gathered params _validate provides, and
+            # Optimizer._validate merges results across hosts.
+            from bigdl_tpu.optim.validator import local_sharded_eval
+            eval_fn = local_sharded_eval(eval_apply)
+        else:
+            jit_eval = jax.jit(eval_apply,
+                               in_shardings=(param_shard, repl,
+                                             batch_shard),
+                               out_shardings=batch_shard)
 
-        def eval_fn(p, s, d):
-            # pad remainder batches up to a multiple of the mesh size, then
-            # trim (validation sets need not divide the mesh — the
-            # reference's per-partition eval had the same freedom,
-            # DistriValidator.scala:38-78)
-            d = np.asarray(d)
-            n = d.shape[0]
-            pad = (-n) % n_shards
-            if pad:
-                d = np.concatenate([d, np.repeat(d[-1:], pad, axis=0)])
-            out = jit_eval(p, s, jax.device_put(d, batch_shard))
-            return np.asarray(out)[:n]
+            def eval_fn(p, s, d):
+                # pad remainder batches up to a multiple of the mesh
+                # size, then trim (validation sets need not divide the
+                # mesh — the reference's per-partition eval had the same
+                # freedom, DistriValidator.scala:38-78)
+                d = np.asarray(d)
+                n = d.shape[0]
+                pad = (-n) % n_shards
+                if pad:
+                    d = np.concatenate([d, np.repeat(d[-1:], pad,
+                                                     axis=0)])
+                out = jit_eval(p, s, jax.device_put(d, batch_shard))
+                return np.asarray(out)[:n]
 
         epoch_start_host_rng = self._host_rng_snapshot()
         data_iter = self.dataset.data(train=True)

@@ -110,27 +110,13 @@ class Metrics:
         process of the jax.distributed job must call this at the same
         point (it rides a device all-gather). Single-process it is a
         plain copy. The originals are left untouched."""
-        import pickle
-
         import jax
-        import numpy as np
+
+        from bigdl_tpu.parallel.collective import process_allgather_pyobj
 
         out = Metrics(keep=self._keep * max(1, jax.process_count()))
-        if jax.process_count() == 1:
-            out._merge_snapshot(self._snapshot())
-            return out
-        from jax.experimental import multihost_utils
-
-        payload = np.frombuffer(pickle.dumps(self._snapshot()), np.uint8)
-        # snapshots differ in size per host: gather lengths, pad, gather
-        sizes = multihost_utils.process_allgather(
-            np.asarray([payload.size], np.int64))
-        buf = np.zeros(int(sizes.max()), np.uint8)
-        buf[:payload.size] = payload
-        bufs = multihost_utils.process_allgather(buf)
-        for p in range(bufs.shape[0]):
-            out._merge_snapshot(pickle.loads(
-                bufs[p, :int(sizes[p])].tobytes()))
+        for snap in process_allgather_pyobj(self._snapshot()):
+            out._merge_snapshot(snap)
         return out
 
     def summary(self, unit: str = "s", scale: float = 1.0) -> str:

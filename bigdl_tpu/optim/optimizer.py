@@ -48,6 +48,26 @@ def _clip_gradients(grads, clip):
     return grads
 
 
+def _require_process_sharded(dataset, what: str):
+    """Multi-host evaluation double-counts unless each process holds its
+    own shard: refuse unsharded datasets and shard counts that don't
+    match the process count (round-5 review findings)."""
+    sharded = hasattr(dataset, "is_sharded") and dataset.is_sharded()
+    if not sharded:
+        raise ValueError(
+            f"multi-host evaluation requires a process-sharded {what} "
+            f"(each of the {jax.process_count()} processes must hold its "
+            "own shard); an unsharded dataset would be double-counted in "
+            "the cross-host reduce")
+    count_fn = getattr(dataset, "process_shard_count", None)
+    shards = count_fn() if count_fn is not None else None
+    if shards is not None and shards != jax.process_count():
+        raise ValueError(
+            f"{what} was built for {shards} process shards but the job "
+            f"has {jax.process_count()} processes — the cross-host "
+            "reduce would mis-count")
+
+
 class Optimizer:
     """Facade + factory (reference optim/Optimizer.scala)."""
 
@@ -169,6 +189,16 @@ class Optimizer:
             fire = self.validation_trigger(driver_state)
         if not fire:
             return None
+        if jax.process_count() > 1:
+            _require_process_sharded(self.validation_dataset,
+                                     "validation dataset")
+            # multi-host: gather params/state to host ONCE per validation
+            # pass (a collective — safe: the fire decision is a
+            # deterministic function of the shared driver state, and it
+            # runs once per pass regardless of per-process batch counts);
+            # apply_fn then evaluates on local devices
+            from bigdl_tpu.utils.file import _to_host
+            params, mstate = _to_host(params), _to_host(mstate)
         results = [None] * len(self.validation_methods)
         count = 0
         t0 = time.perf_counter()
@@ -179,6 +209,16 @@ class Optimizer:
             for i, m in enumerate(self.validation_methods):
                 r = m(out, labels)
                 results[i] = r if results[i] is None else results[i] + r
+        if jax.process_count() > 1:
+            # each process validated its own shard; reduce to the global
+            # result on every host (reference DistriValidator's driver
+            # reduce). Safe as a collective: the trigger is a
+            # deterministic function of the shared driver state
+            from bigdl_tpu.optim.validation import aggregate_results
+            from bigdl_tpu.parallel.collective import \
+                process_allgather_pyobj
+            results = aggregate_results(results)
+            count = sum(process_allgather_pyobj(count))  # global records
         elapsed = time.perf_counter() - t0
         logger.info(f"validate model throughput is "
                     f"{count / max(elapsed, 1e-9):.2f} records/second")

@@ -10,7 +10,26 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["ValidationResult", "AccuracyResult", "LossResult",
-           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss"]
+           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss",
+           "aggregate_results"]
+
+
+def aggregate_results(results):
+    """Monoid-reduce a list of per-process ValidationResults across the
+    jax.distributed job (reference DistriValidator.scala:29-80 — each
+    executor evaluates its partition, the driver reduces): every host
+    returns the all-hosts sums. COLLECTIVE (all processes must call at
+    the same point); single-process it returns ``results`` unchanged.
+    ``None`` entries (a host whose shard was empty) are skipped."""
+    from bigdl_tpu.parallel.collective import process_allgather_pyobj
+    per_host = process_allgather_pyobj(list(results))
+    merged = list(per_host[0])
+    for host_results in per_host[1:]:
+        for i, r in enumerate(host_results):
+            if r is None:
+                continue
+            merged[i] = r if merged[i] is None else merged[i] + r
+    return merged
 
 
 class ValidationResult:

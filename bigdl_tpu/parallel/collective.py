@@ -31,7 +31,37 @@ except ImportError:                    # older jax
 from bigdl_tpu.parallel.engine import get_mesh
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
-           "all_to_all", "psum_tree", "pmean_tree"]
+           "all_to_all", "psum_tree", "pmean_tree",
+           "process_allgather_pyobj"]
+
+
+def process_allgather_pyobj(obj):
+    """Gather one arbitrary (picklable) python object per PROCESS; every
+    process returns the list ordered by process index.
+
+    The host-side control-plane counterpart to the in-step collectives
+    above — the role Spark's driver-side reduce/accumulators played in
+    the reference (Metrics.scala:24-27, DistriValidator.scala:29-80).
+    COLLECTIVE over the jax.distributed job: every process must call it
+    at the same point. Single-process: returns ``[obj]`` without
+    touching the backend. Objects differ in size per process, so lengths
+    are gathered first and payloads padded to the max."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[:payload.size] = payload
+    bufs = multihost_utils.process_allgather(buf)
+    return [pickle.loads(bufs[p, :int(sizes[p])].tobytes())
+            for p in range(bufs.shape[0])]
 
 
 def _wire(x, wire_dtype):

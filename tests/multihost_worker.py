@@ -88,6 +88,51 @@ def dp_pp_losses(mesh, steps=4, nproc=1, pid=0):
     return losses
 
 
+def sp_losses(mesh, kind, steps=4, nproc=1, pid=0):
+    """Sequence-parallel (ring or Ulysses) attention train step with the
+    'seq' axis spanning processes — the ppermute / all_to_all collectives
+    cross the process boundary (DCN path on a real pod). Deterministic
+    data; multi-process callers pass their contiguous sequence slice."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu import nn
+
+    attn = nn.MultiHeadAttention(32, 8, causal=True,
+                                 sequence_parallel=kind)
+    attn.materialize(jax.random.PRNGKey(0))
+    params = attn.params
+    rs = np.random.RandomState(0)
+    gx = rs.rand(4, 32, 32).astype(np.float32)     # (B, S, E), S % 8 == 0
+    gt = rs.rand(4, 32, 32).astype(np.float32)
+    sharding = NamedSharding(mesh, P(None, "seq"))
+    if nproc > 1:
+        lo, hi = pid * 32 // nproc, (pid + 1) * 32 // nproc
+        xg = jax.make_array_from_process_local_data(sharding, gx[:, lo:hi])
+        tg = jax.make_array_from_process_local_data(sharding, gt[:, lo:hi])
+    else:
+        xg = jax.device_put(jnp.asarray(gx), sharding)
+        tg = jax.device_put(jnp.asarray(gt), sharding)
+
+    def loss_fn(p, x, t):
+        y, _ = attn.apply(p, {}, x)
+        return jnp.mean((y - t) ** 2)
+
+    @jax.jit
+    def step(p, x, t):
+        l, g = jax.value_and_grad(loss_fn)(p, x, t)
+        return l, jax.tree.map(lambda w, gw: w - 0.2 * gw, p, g)
+
+    losses = []
+    with mesh:
+        for _ in range(steps):
+            l, params = step(params, xg, tg)
+            losses.append(float(l))
+    return losses
+
+
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
@@ -128,6 +173,13 @@ def main():
         Engine.reset()
         mesh = Engine.init(axes={"data": 4, "model": 2})
         pls = dp_pp_losses(mesh, steps=4, nproc=nproc, pid=pid)
+        print(f"LOSSES {pid} {json.dumps(pls)}", flush=True)
+        return
+
+    if mode.startswith("sp:"):          # ring/ulysses across processes
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 8})
+        pls = sp_losses(mesh, mode[3:], steps=4, nproc=nproc, pid=pid)
         print(f"LOSSES {pid} {json.dumps(pls)}", flush=True)
         return
 
@@ -182,6 +234,50 @@ def main():
     # shuffles are per-shard, like the reference's per-partition shuffle,
     # so they can't match a single-process control)
     ds = sharded >> SampleToBatch(16 // nproc, drop_remainder=True)
+
+    if base == "validate":
+        # standalone cross-host evaluation (reference DistriValidator):
+        # each process evaluates ITS shard; the merged result every host
+        # reports must cover all 64 samples
+        from bigdl_tpu.optim.validation import Loss, Top1Accuracy
+        from bigdl_tpu.optim.validator import Validator
+        vmodel = nn.Sequential(nn.Linear(2, 8), nn.Tanh(),
+                               nn.Linear(8, 2), nn.LogSoftMax())
+        vmodel.materialize(jax.random.PRNGKey(0))
+        vds = sharded >> SampleToBatch(8, drop_remainder=False)
+        Engine.reset()
+        mesh = Engine.init()
+        v = Validator(vmodel, vds, mesh=mesh)
+        (acc, _), (lr, _) = v.test(
+            [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+
+        # in-training validation through DistriOptimizer's eval path
+        # (round-5 review finding: it crashed multi-host before) —
+        # capture the logged cross-host-merged Top1 result
+        val_counts = []
+
+        class VRec(logging.Handler):
+            def emit(self, record):
+                msg = record.getMessage()
+                if "Top1Accuracy is" in msg:
+                    val_counts.append(
+                        int(msg.split("count: ")[1].split(",")[0]))
+
+        logger.addHandler(VRec())
+        tmodel = nn.Sequential(nn.Linear(2, 16), nn.Tanh(),
+                               nn.Linear(16, 2), nn.LogSoftMax())
+        o = optim.Optimizer(model=tmodel, dataset=ds,
+                            criterion=nn.ClassNLLCriterion(), mesh=mesh)
+        o.set_optim_method(optim.SGD(learning_rate=0.2, momentum=0.9))
+        o.set_validation(optim.several_iteration(2), vds,
+                         [Top1Accuracy()])
+        o.set_end_when(optim.max_iteration(2))
+        o.optimize()
+
+        payload = [acc.correct, acc.count, lr.loss, lr.count, val_counts]
+        print(f"LOSSES {pid} []", flush=True)
+        print(f"VAL {pid} {json.dumps(payload)}", flush=True)
+        return
 
     if resume_dir is not None:
         from bigdl_tpu.utils import file as bfile

@@ -80,7 +80,7 @@ def _single_process_control():
 
 def _run_workers(mode, nproc=2):
     """Spawn ``nproc`` worker processes; return ({pid: losses},
-    {pid: metrics}) parsed from their LOSSES/METRICS lines. Shared by
+    {pid: metrics}, {pid: val}) parsed from their tagged output lines. Shared by
     every multihost test (review finding: the spawn/skip/parse block was
     triplicated)."""
     port = _free_port()
@@ -106,21 +106,20 @@ def _run_workers(mode, nproc=2):
                         or "coordinator" in err.lower()):
             pytest.skip(f"jax.distributed unavailable here: {err[-400:]}")
         assert rc == 0, f"worker failed:\n{err[-2000:]}"
-    losses, metrics = {}, {}
+    tags = {"LOSSES": {}, "METRICS": {}, "VAL": {}}
     for rc, out, err in outs:
         for line in out.splitlines():
-            if line.startswith("LOSSES "):
-                _, pid, payload = line.split(" ", 2)
-                losses[int(pid)] = json.loads(payload)
-            elif line.startswith("METRICS "):
-                _, pid, payload = line.split(" ", 2)
-                metrics[int(pid)] = json.loads(payload)
+            tag, _, rest = line.partition(" ")
+            if tag in tags:
+                pid, payload = rest.split(" ", 1)
+                tags[tag][int(pid)] = json.loads(payload)
+    losses = tags["LOSSES"]
     assert set(losses) == set(range(nproc)), f"missing loss lines: {outs}"
-    return losses, metrics
+    return losses, tags["METRICS"], tags["VAL"]
 
 
 def test_two_process_training_matches_single_process():
-    losses, metrics = _run_workers("dp")
+    losses, metrics, _ = _run_workers("dp")
     assert len(losses[0]) == 4
     # lockstep: both processes observe the identical global computation
     np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
@@ -135,7 +134,7 @@ def test_two_process_training_matches_single_process():
 def test_four_process_training_matches_single_process():
     """4 processes x 2 devices — the harness is not shaped around
     nproc=2 (VERDICT r4 item 2)."""
-    losses, metrics = _run_workers("dp", nproc=4)
+    losses, metrics, _ = _run_workers("dp", nproc=4)
     for pid in range(1, 4):
         np.testing.assert_allclose(losses[0], losses[pid], rtol=0, atol=0)
     control = _single_process_control()
@@ -148,7 +147,7 @@ def test_two_process_dp_tp_matches_single_process():
     {"data": 4, "model": 2} mesh spanning 2 OS processes with GSPMD
     tensor-parallel params trains in lockstep; TP is layout-only, so the
     trajectory equals the pure-dp single-process control."""
-    losses, _ = _run_workers("dp_tp")
+    losses, _, _ = _run_workers("dp_tp")
     np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
     control = _single_process_control()
     np.testing.assert_allclose(losses[0], control, rtol=1e-4)
@@ -158,7 +157,7 @@ def test_two_process_dp_pp_matches_single_process():
     """GPipe stages composed with a data axis, both spanning processes
     (VERDICT r4 item 2): the microbatch loop's collective permutes ride
     the same global mesh as the data-axis sharding."""
-    losses, _ = _run_workers("dp_pp")
+    losses, _, _ = _run_workers("dp_pp")
     np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
     assert losses[0][-1] < losses[0][0]          # it actually trains
 
@@ -183,11 +182,11 @@ def test_multihost_checkpoint_kill_resume(tmp_path, tp):
     arrays via a process allgather, and resume re-shards them over the
     fresh mesh."""
     suffix = "_tp" if tp else ""
-    full, _ = _run_workers("dp_tp" if tp else "dp")
+    full, _, _ = _run_workers("dp_tp" if tp else "dp")
     assert len(full[0]) == 4
 
     ck = tmp_path / "ck"
-    first, _ = _run_workers(f"ckpt{suffix}:{ck}")
+    first, _, _ = _run_workers(f"ckpt{suffix}:{ck}")
     np.testing.assert_allclose(first[0], first[1], rtol=0, atol=0)
     # several_iteration(3) fires when post-increment neval hits 3, i.e.
     # after 2 completed steps — the snapshot is model.3/state.3
@@ -195,7 +194,7 @@ def test_multihost_checkpoint_kill_resume(tmp_path, tp):
     assert (ck / "p0" / "model.3").exists()
     assert (ck / "p1" / "state.3").exists()
 
-    resumed, _ = _run_workers(f"resume{suffix}:{ck}")
+    resumed, _, _ = _run_workers(f"resume{suffix}:{ck}")
     np.testing.assert_allclose(resumed[0], resumed[1], rtol=0, atol=0)
     assert len(resumed[0]) == 2
     np.testing.assert_allclose(resumed[0], full[0][2:], rtol=1e-5)
@@ -217,6 +216,87 @@ def _write_u8_shards(tmp_path, num_shards):
                 w.write(buf.getvalue(), float(i % 4 + 1))
 
 
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_two_process_sequence_parallel_matches_single_process(kind):
+    """The long-context axis ACROSS processes: an 8-way 'seq' mesh
+    spanning 2 OS processes — ring's ppermute / Ulysses' all_to_all
+    cross the process boundary (the DCN path on a real pod). Trajectory
+    must match the identical code on 8 local devices."""
+    losses, _, _ = _run_workers(f"sp:{kind}")
+    np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
+    assert losses[0][-1] < losses[0][0]
+
+    import multihost_worker
+    from bigdl_tpu.parallel import Engine
+    Engine.reset()
+    mesh = Engine.init(axes={"seq": 8})
+    try:
+        control = multihost_worker.sp_losses(mesh, kind, steps=4)
+    finally:
+        Engine.reset()
+    np.testing.assert_allclose(losses[0], control, rtol=1e-5)
+
+
+def test_multihost_validation_aggregates_all_hosts():
+    """Cross-host validation (reference DistriValidator's driver reduce):
+    each process evaluates its own 32-sample shard; every host's merged
+    result must cover all 64 samples and equal the single-process
+    evaluation of the full set."""
+    _, _, val = _run_workers("validate")
+    assert set(val) == {0, 1}
+    # identical merged result on every host
+    assert val[0] == val[1]
+    correct, count, loss_sum, loss_count, train_val_counts = val[0]
+    assert count == 64 and loss_count == 64
+    # in-training validation (DistriOptimizer eval path) also reduced
+    # across hosts: the logged Top1 covers all 64 samples on every host
+    assert train_val_counts == [64]
+
+    # single-process control over the full dataset
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import Sample, SampleToBatch
+    from bigdl_tpu.dataset.dataset import ShardedDataSet
+    from bigdl_tpu.optim.validation import Loss, Top1Accuracy
+    from bigdl_tpu.optim.validator import LocalValidator
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+    ds = ShardedDataSet(samples, num_shards=1, shard_index=0) \
+        >> SampleToBatch(8, drop_remainder=False)
+    model = nn.Sequential(nn.Linear(2, 8), nn.Tanh(), nn.Linear(8, 2),
+                          nn.LogSoftMax())
+    model.materialize(jax.random.PRNGKey(0))
+    (acc, _), (lr, _) = LocalValidator(model, ds).test(
+        [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+    assert (acc.correct, acc.count) == (correct, count)
+    np.testing.assert_allclose(loss_sum, lr.loss, rtol=1e-5)
+
+
+def test_multihost_eval_guard_refuses_double_counting(monkeypatch):
+    """An unsharded (or wrong-shard-count) dataset on a multi-host job
+    would be evaluated in full by every process and double-counted by the
+    cross-host reduce — the guard must refuse both (round-5 review)."""
+    import jax
+
+    from bigdl_tpu.dataset.dataset import (LocalArrayDataSet,
+                                           ShardedDataSet)
+    from bigdl_tpu.optim.optimizer import _require_process_sharded
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="process-sharded"):
+        _require_process_sharded(LocalArrayDataSet([1, 2]), "dataset")
+    with pytest.raises(ValueError, match="2 processes"):
+        _require_process_sharded(ShardedDataSet([1, 2], num_shards=1),
+                                 "dataset")
+    # matching shard count passes, including through transform wrappers
+    from bigdl_tpu.dataset import Sample, SampleToBatch
+    ds = ShardedDataSet([Sample(np.zeros(2), 1)] * 4, num_shards=2) \
+        >> SampleToBatch(2)
+    _require_process_sharded(ds, "dataset")
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multiprocess_u8_shard_pipeline(tmp_path, nproc):
     """The production ImageNet input path across processes (round-4
@@ -229,7 +309,7 @@ def test_multiprocess_u8_shard_pipeline(tmp_path, nproc):
         pytest.skip("no native toolchain")
     _write_u8_shards(tmp_path, nproc)
 
-    losses, _ = _run_workers(f"u8:{tmp_path}", nproc=nproc)
+    losses, _, _ = _run_workers(f"u8:{tmp_path}", nproc=nproc)
     assert len(losses[0]) == 4
     assert all(np.isfinite(losses[0]))
     # lockstep: all processes observe the identical global computation
