@@ -56,6 +56,12 @@ class AbstractDataSet:
         setups."""
         return None
 
+    def process_shard_index(self):
+        """Which process shard this dataset holds (through transforms),
+        or None when unknown — the multi-host guards assert indices are
+        distinct across processes."""
+        return None
+
     def get_position_state(self):
         """Checkpointable pipeline position (shuffle permutation etc.);
         None when the source has no such state. Paired with
@@ -89,6 +95,9 @@ class TransformedDataSet(AbstractDataSet):
 
     def process_shard_count(self):
         return self.base.process_shard_count()
+
+    def process_shard_index(self):
+        return self.base.process_shard_index()
 
     def get_position_state(self):
         return self.base.get_position_state()
@@ -200,6 +209,9 @@ class ShardedDataSet(PassRotationMixin, AbstractDataSet):
 
     def process_shard_count(self):
         return self.num_shards
+
+    def process_shard_index(self):
+        return self.shard_index
 
     def is_sharded(self):
         return True

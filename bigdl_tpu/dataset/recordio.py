@@ -232,6 +232,9 @@ class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
     def process_shard_count(self):
         return self.process_count
 
+    def process_shard_index(self):
+        return self.process_index
+
     def size(self) -> int:
         """Global record count (reference DistributedDataSet.size)."""
         return sum(self._count(p) for p in self._all_paths)

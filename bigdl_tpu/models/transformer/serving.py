@@ -41,8 +41,8 @@ from bigdl_tpu.models.transformer.generate import (
     _proj, _sample, _split_heads)
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
-__all__ = ["generate_ragged", "PagedKVCache", "paged_decode",
-           "speculative_generate"]
+__all__ = ["generate_ragged", "PagedKVCache", "paged_prefill",
+           "paged_decode", "speculative_generate"]
 
 
 def _rope_rows(x, positions, theta: float = 10000.0):
@@ -293,6 +293,88 @@ def _paged_view(pool, table):
 
 
 @functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=(
+    "num_layers", "num_heads", "page_size", "policy_key", "rope",
+    "num_kv_heads"))
+def _paged_prefill_impl(params, kp, vp, table, prompt, lengths, *,
+                        num_layers, num_heads, page_size, policy_key,
+                        rope=False, num_kv_heads=None):
+    """Prefill right-padded prompts (B, Pmax) INTO the page pool.
+
+    Column j of row i writes physical slot (table[i, j//S], j%S); padding
+    columns (j >= lengths[i]) scatter to an out-of-range page id and are
+    dropped, so they can never corrupt pages the table maps for other
+    rows. Returns (greedy first token (B,), kp, vp)."""
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    dtype = activation_dtype()
+    b, pmax = prompt.shape
+    num_pages = kp[0].shape[0]
+    x = _embed(embed, prompt, 0).astype(dtype)
+    cols = jnp.broadcast_to(jnp.arange(pmax)[None, :], (b, pmax))
+    valid = cols < lengths[:, None]
+    log_page = table[jnp.arange(b)[:, None], cols // page_size]
+    phys = jnp.where(valid, log_page, num_pages)     # OOB -> drop
+    slot = cols % page_size
+    new_kp, new_vp = list(kp), list(vp)
+    scale = (x.shape[-1] // num_heads) ** -0.5
+    for li in range(num_layers):
+        q, k, v = _qkv(blocks[li], x, num_heads, num_kv_heads)
+        if rope:
+            q = _rope_rows(q, cols)
+            k = _rope_rows(k, cols)
+        new_kp[li] = new_kp[li].at[phys, slot].set(
+            k.astype(kp[li].dtype), mode="drop")
+        new_vp[li] = new_vp[li].at[phys, slot].set(
+            v.astype(vp[li].dtype), mode="drop")
+        ckv = _paged_view(new_kp[li], table)
+        cvv = _paged_view(new_vp[li], table)
+        o = _attend_grouped(q, ckv, cvv, cols, num_heads, scale)
+        o = o.reshape(x.shape).astype(x.dtype)
+        x = x + _proj(blocks[li]["0"]["1"], "out",
+                      o).astype(activation_dtype())
+        x = x + _ffn(blocks[li]["1"]["1"], _ln(blocks[li]["1"]["0"], x))
+    logits = _row_logits(params, num_layers, x, lengths - 1)
+    first = jnp.argmax(logits.astype(jnp.float32), axis=-1) + 1
+    return first, tuple(new_kp), tuple(new_vp)
+
+
+def paged_prefill(model, cache: PagedKVCache, table, prompts, *,
+                  params=None):
+    """Prefill a mixed-length prompt batch into the paged pool.
+
+    ``table``: (B, pages_per_seq) physical-page ids covering at least
+    each row's prompt AND the tokens to be decoded after it. Returns
+    (greedy first tokens (B,), lengths (B,)) — feed both straight into
+    :func:`paged_decode`; pool arrays inside ``cache`` are rebound."""
+    params = model.params if params is None else params
+    meta = model.lm_meta
+    lengths = np.asarray([len(p) for p in prompts], np.int32)
+    table = np.asarray(table, np.int32)
+    capacity = table.shape[1] * cache.page_size
+    if int(lengths.max()) > capacity:
+        # without this the cols//page_size gather clamps to the last
+        # table column and valid tokens silently overwrite one page
+        # (round-5 review finding)
+        raise ValueError(
+            f"prompt of {int(lengths.max())} tokens exceeds the table's "
+            f"{table.shape[1]} pages x {cache.page_size} slots "
+            f"= {capacity}-token capacity")
+    pmax = int(lengths.max())
+    batch = np.ones((len(prompts), pmax), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = np.asarray(p, np.int32)
+    policy_key = (str(activation_dtype()), str(compute_dtype()))
+    first, kp, vp = _paged_prefill_impl(
+        params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
+        jnp.asarray(batch), jnp.asarray(lengths),
+        num_layers=meta["num_layers"], num_heads=meta["num_heads"],
+        page_size=cache.page_size, policy_key=policy_key,
+        rope=meta.get("pos_encoding", "learned") == "rope",
+        num_kv_heads=meta.get("num_kv_heads"))
+    cache.kp, cache.vp = kp, vp
+    return first, lengths
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=(
     "num_layers", "num_heads", "n_new", "page_size", "temperature",
     "top_k", "policy_key", "rope", "num_kv_heads"))
 def _paged_decode_impl(params, kp, vp, table, lengths, tok0, rng, *,
@@ -360,6 +442,14 @@ def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
     config = config or GenerationConfig(max_new_tokens=n_new)
     params = model.params if params is None else params
     meta = model.lm_meta
+    table = np.asarray(table, np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    capacity = table.shape[1] * cache.page_size
+    if int(lengths.max()) + n_new > capacity:
+        raise ValueError(
+            f"decoding {n_new} tokens past length {int(lengths.max())} "
+            f"exceeds the table's {capacity}-token capacity "
+            f"({table.shape[1]} pages x {cache.page_size} slots)")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     policy_key = (str(activation_dtype()), str(compute_dtype()))
@@ -383,16 +473,29 @@ def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
 
 @functools.partial(jax.jit, static_argnames=(
     "t_layers", "t_heads", "t_kv", "t_rope", "d_layers", "d_heads",
-    "d_kv", "d_rope", "max_len", "n_new", "gamma", "policy_key"))
-def _speculative_impl(t_params, d_params, prompt, lengths, *, t_layers,
-                      t_heads, t_kv, t_rope, d_layers, d_heads, d_kv,
-                      d_rope, max_len, n_new, gamma, policy_key):
-    """Greedy speculative loop. Per outer round: draft proposes gamma
-    tokens one-by-one, target verifies all gamma+1 positions in ONE
-    T=gamma+1 cache step, rows accept their longest agreeing prefix plus
-    the target's correction token. Rows advance at different rates, so
-    positions/caches are the ragged machinery. Returns (tokens
-    (B, n_new), accepted_draft_total, rounds)."""
+    "d_kv", "d_rope", "max_len", "n_new", "gamma", "temperature",
+    "policy_key"))
+def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
+                      t_layers, t_heads, t_kv, t_rope, d_layers, d_heads,
+                      d_kv, d_rope, max_len, n_new, gamma,
+                      temperature, policy_key):
+    """Speculative loop. Per outer round: draft proposes gamma tokens
+    one-by-one, target verifies all gamma+1 positions in ONE T=gamma+1
+    cache step, rows accept a prefix plus one correction/bonus token.
+    Rows advance at different rates, so positions/caches are the ragged
+    machinery.
+
+    ``temperature == 0``: greedy draft-and-verify — accept the longest
+    prefix where draft argmax == target argmax; output is BITWISE the
+    target's greedy decode. ``temperature > 0``: Leviathan-style
+    rejection sampling — draft token x_j accepted with probability
+    min(1, p_t(x_j)/p_d(x_j)); on rejection the replacement is drawn
+    from the normalized residual max(p_t - p_d, 0), and after a fully
+    accepted window the bonus is drawn from p_t at the next position.
+    Either way the output distribution IS the target model's (the
+    distribution-exactness statistical test lives in
+    tests/test_serving.py). Returns (tokens (B, n_new),
+    accepted_draft_total, rounds)."""
     embed_t, blocks_t, _, _ = _model_parts(t_params, t_layers)
     embed_d, blocks_d, _, _ = _model_parts(d_params, d_layers)
     dtype = activation_dtype()
@@ -403,16 +506,24 @@ def _speculative_impl(t_params, d_params, prompt, lengths, *, t_layers,
     dck, dcv, dx = _ragged_prefill(d_params, prompt, d_layers,
                                    d_heads, max_len, d_rope, d_kv)
     t_logits = _row_logits(t_params, t_layers, tx, lengths - 1)
-    first = jnp.argmax(t_logits.astype(jnp.float32), axis=-1) + 1
+    rng, key0 = jax.random.split(rng)
+    if temperature == 0.0:
+        first = jnp.argmax(t_logits.astype(jnp.float32), axis=-1) + 1
+    else:
+        first = jax.random.categorical(
+            key0, t_logits.astype(jnp.float32) / temperature, axis=-1) + 1
 
     out = jnp.zeros((b, n_new), jnp.int32).at[:, 0].set(first)
     # n_done counts emitted tokens per row; pos = position of the last
     # CACHED token (the prompt end); `first` is emitted but not yet cached
     n_done = jnp.ones((b,), jnp.int32)
     pos = lengths - 1
+    vocab = embed_t["tok"].shape[0]
 
-    def d_step(tok, dck, dcv, p):
-        """One greedy draft step at per-row position p+1."""
+    def d_step(tok, dck, dcv, p, key):
+        """One draft step at per-row position p+1: greedy token when
+        temperature==0, else a sample plus the full draft distribution
+        (needed for the acceptance ratio and the residual)."""
         x = _embed_rows(embed_d, tok[:, None], (p + 1)[:, None]
                         ).astype(dtype)
         nck, ncv = list(dck), list(dcv)
@@ -420,28 +531,36 @@ def _speculative_impl(t_params, d_params, prompt, lengths, *, t_layers,
             x, nck[li], ncv[li] = _ragged_block_step(
                 blocks_d[li], x, dck[li], dcv[li], p + 1, d_heads,
                 max_len, d_rope, d_kv)
-        lg = _row_logits(d_params, d_layers, x, jnp.zeros_like(p))
-        return (jnp.argmax(lg.astype(jnp.float32), axis=-1) + 1,
-                tuple(nck), tuple(ncv))
+        lg = _row_logits(d_params, d_layers, x,
+                         jnp.zeros_like(p)).astype(jnp.float32)
+        if temperature == 0.0:
+            return (jnp.argmax(lg, axis=-1) + 1, None,
+                    tuple(nck), tuple(ncv))
+        probs = jax.nn.softmax(lg / temperature, axis=-1)
+        tok = jax.random.categorical(key, lg / temperature, axis=-1) + 1
+        return tok, probs, tuple(nck), tuple(ncv)
 
     def round_body(carry):
-        out, n_done, pos, tck, tcv, dck, dcv, acc, rounds = carry
+        out, n_done, pos, tck, tcv, dck, dcv, acc, rounds, rng = carry
+        rng, r_draft, r_acc, r_bonus = jax.random.split(rng, 4)
         # rows already finished keep proposing into masked positions;
         # their writes land beyond max_len-1? No: clamp via mode="drop"
         # in the scatter and the emit mask below.
         last = jnp.take_along_axis(out, (n_done - 1)[:, None],
                                    axis=1)[:, 0]
-        # --- draft: gamma greedy proposals, PLUS one extra step whose
-        # only job is caching props[gamma-1] (its proposal is discarded)
-        # — without it a fully-accepted round would leave the next
-        # round's draft attending a hole at that position
-        proposals = []
+        # --- draft: gamma proposals, PLUS one extra step whose only job
+        # is caching props[gamma-1] (its proposal is discarded) —
+        # without it a fully-accepted round would leave the next round's
+        # draft attending a hole at that position
+        proposals, d_probs = [], []
         dtok = last
         dp = pos
+        dkeys = jax.random.split(r_draft, gamma + 1)
         for gi in range(gamma + 1):
-            dtok, dck, dcv = d_step(dtok, dck, dcv, dp)
+            dtok, dprob, dck, dcv = d_step(dtok, dck, dcv, dp, dkeys[gi])
             if gi < gamma:
                 proposals.append(dtok)
+                d_probs.append(dprob)
             dp = dp + 1
         props = jnp.stack(proposals, axis=1)              # (B, gamma)
         # --- target: ONE T=gamma+1 cache step over [last, props] scores
@@ -459,16 +578,42 @@ def _speculative_impl(t_params, d_params, prompt, lengths, *, t_layers,
                 max_len, t_rope, t_kv)
         _, _, norm_p, head_p = _model_parts(t_params, t_layers)
         tg = _linear(head_p, _ln(norm_p, x)).astype(jnp.float32)
-        t_choice = jnp.argmax(tg, axis=-1) + 1            # (B, gamma+1)
-        # --- accept longest agreeing prefix --------------------------
-        agree = (props == t_choice[:, :gamma])            # (B, gamma)
-        # a(i) = #accepted draft tokens = leading-True run length
-        acc_len = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)  # (B,)
-        # emitted this round = accepted drafts + 1 target correction
-        # token; acc_len==gamma -> the bonus is the target's sample
-        # past ALL drafts (column gamma exists because verify is T=γ+1)
+        if temperature == 0.0:
+            t_choice = jnp.argmax(tg, axis=-1) + 1        # (B, gamma+1)
+            # --- accept longest agreeing prefix ----------------------
+            a = (props == t_choice[:, :gamma])            # (B, gamma)
+            acc_len = jnp.sum(jnp.cumprod(a, axis=1), axis=1)   # (B,)
+            bonus = t_choice[jnp.arange(b), acc_len]
+        else:
+            # --- Leviathan rejection sampling ------------------------
+            pt = jax.nn.softmax(tg / temperature, axis=-1)  # (B,γ+1,V)
+            pd = jnp.stack(d_probs, axis=1)                 # (B,γ,V)
+            pidx = (props - 1)[..., None]                   # 0-based
+            pt_x = jnp.take_along_axis(pt[:, :gamma], pidx,
+                                       axis=-1)[..., 0]     # (B,γ)
+            pd_x = jnp.take_along_axis(pd, pidx, axis=-1)[..., 0]
+            u = jax.random.uniform(r_acc, (b, gamma))
+            # u < min(1, pt/pd)  <=>  u*pd < pt (division-free)
+            a = u * pd_x < pt_x
+            acc_len = jnp.sum(jnp.cumprod(a, axis=1), axis=1)
+            # replacement at the reject position: residual
+            # max(pt - pd, 0) normalized; after a fully accepted window
+            # (acc_len==gamma) pd is zero-padded there, so the residual
+            # IS pt[gamma] — one uniform rule covers both cases
+            pd_pad = jnp.concatenate(
+                [pd, jnp.zeros((b, 1, vocab), pd.dtype)], axis=1)
+            pt_at = pt[jnp.arange(b), acc_len]              # (B, V)
+            pd_at = pd_pad[jnp.arange(b), acc_len]
+            resid = jnp.maximum(pt_at - pd_at, 0.0)
+            z = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-20),
+                              pt_at)
+            bonus = jax.random.categorical(
+                r_bonus, jnp.log(jnp.maximum(resid, 1e-37)),
+                axis=-1) + 1
+        # emitted this round = accepted drafts + 1 correction/bonus
+        # token (column gamma exists because verify is T=γ+1)
         emit_n = acc_len + 1
-        bonus = t_choice[jnp.arange(b), acc_len]
         # ragged emit into `out`: row b writes tokens at n_done..+emit_n
         cols = n_done[:, None] + jnp.arange(gamma + 1)[None, :]
         vals = jnp.concatenate([props, bonus[:, None]], axis=1)
@@ -495,26 +640,33 @@ def _speculative_impl(t_params, d_params, prompt, lengths, *, t_layers,
         # too (the draft's own tokens up to the disagreement point).
         pos = pos + 1 + acc_len
         return (out, n_done, pos, tuple(ntck), tuple(ntcv), dck, dcv,
-                acc, rounds + 1)
+                acc, rounds + 1, rng)
 
     def cond(carry):
-        _, n_done, _, _, _, _, _, _, _ = carry
+        n_done = carry[1]
         return jnp.any(n_done < n_new)
 
     zero_acc = jnp.zeros((), jnp.int32)
     carry = (out, n_done, pos, tck, tcv, dck, dcv, zero_acc,
-             jnp.zeros((), jnp.int32))
-    out, n_done, pos, _, _, _, _, acc, rounds = jax.lax.while_loop(
+             jnp.zeros((), jnp.int32), rng)
+    out, n_done, pos, _, _, _, _, acc, rounds, _ = jax.lax.while_loop(
         cond, round_body, carry)
     return out, acc, rounds
 
 
 def speculative_generate(model, draft_model, prompts, *,
                          max_new_tokens: int = 32, gamma: int = 4,
+                         temperature: float = 0.0, rng=None,
                          params=None, draft_params=None):
-    """Greedy speculative decoding: EXACTLY the target model's greedy
-    output (pinned by tests/test_serving.py), produced with ~1 target
-    forward per ``accepted+1`` tokens instead of per token.
+    """Speculative decoding with ~1 target forward per ``accepted+1``
+    tokens instead of per token.
+
+    ``temperature == 0`` (default): greedy draft-and-verify — output is
+    EXACTLY the target model's greedy continuation, whatever the draft
+    proposes. ``temperature > 0``: Leviathan rejection sampling — the
+    output DISTRIBUTION is exactly the target model's sampling
+    distribution at that temperature (both pinned by
+    tests/test_serving.py).
 
     ``prompts``: list of 1-based id sequences (mixed lengths ride the
     ragged path). Returns ``(tokens (B, max_new_tokens), stats)`` where
@@ -522,6 +674,8 @@ def speculative_generate(model, draft_model, prompts, *,
     and ``rounds``."""
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     t_meta, d_meta = model.lm_meta, draft_model.lm_meta
     lengths = np.asarray([len(p) for p in prompts], np.int32)
     pmax = int(lengths.max())
@@ -534,8 +688,11 @@ def speculative_generate(model, draft_model, prompts, *,
     t_params = model.params if params is None else params
     d_params = draft_model.params if draft_params is None else draft_params
     policy_key = (str(activation_dtype()), str(compute_dtype()))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     out, acc, rounds = _speculative_impl(
         t_params, d_params, jnp.asarray(batch), jnp.asarray(lengths),
+        rng,
         t_layers=t_meta["num_layers"], t_heads=t_meta["num_heads"],
         t_kv=t_meta.get("num_kv_heads"),
         t_rope=t_meta.get("pos_encoding", "learned") == "rope",
@@ -543,7 +700,8 @@ def speculative_generate(model, draft_model, prompts, *,
         d_kv=d_meta.get("num_kv_heads"),
         d_rope=d_meta.get("pos_encoding", "learned") == "rope",
         max_len=min(t_meta["max_len"], d_meta["max_len"]),
-        n_new=max_new_tokens, gamma=gamma, policy_key=policy_key)
+        n_new=max_new_tokens, gamma=gamma,
+        temperature=float(temperature), policy_key=policy_key)
     rounds_i = max(int(rounds), 1)
     proposed = rounds_i * gamma * len(prompts)
     stats = {"acceptance_rate": float(int(acc)) / proposed,

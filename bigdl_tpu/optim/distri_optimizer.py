@@ -219,24 +219,14 @@ class DistriOptimizer(Optimizer):
             from bigdl_tpu.optim.validator import local_sharded_eval
             eval_fn = local_sharded_eval(eval_apply)
         else:
+            from bigdl_tpu.optim.validator import _padded_eval
             jit_eval = jax.jit(eval_apply,
                                in_shardings=(param_shard, repl,
                                              batch_shard),
                                out_shardings=batch_shard)
-
-            def eval_fn(p, s, d):
-                # pad remainder batches up to a multiple of the mesh
-                # size, then trim (validation sets need not divide the
-                # mesh — the reference's per-partition eval had the same
-                # freedom, DistriValidator.scala:38-78)
-                d = np.asarray(d)
-                n = d.shape[0]
-                pad = (-n) % n_shards
-                if pad:
-                    d = np.concatenate([d, np.repeat(d[-1:], pad,
-                                                     axis=0)])
-                out = jit_eval(p, s, jax.device_put(d, batch_shard))
-                return np.asarray(out)[:n]
+            # params stay in their training placement (param_shard may be
+            # ZeRO-sharded) — only the batch is padded/placed/trimmed
+            eval_fn = _padded_eval(jit_eval, batch_shard, n_shards)
 
         epoch_start_host_rng = self._host_rng_snapshot()
         data_iter = self.dataset.data(train=True)

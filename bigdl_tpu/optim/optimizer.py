@@ -50,22 +50,40 @@ def _clip_gradients(grads, clip):
 
 def _require_process_sharded(dataset, what: str):
     """Multi-host evaluation double-counts unless each process holds its
-    own shard: refuse unsharded datasets and shard counts that don't
-    match the process count (round-5 review findings)."""
+    OWN shard: refuse unsharded datasets, shard counts that don't match
+    the process count, and duplicate shard indices (e.g. every process
+    left shard_index at the default 0 — round-5 review findings).
+
+    COLLECTIVE: gathers every process's local view FIRST so all hosts
+    reach the same verdict from the same data — a host-local raise while
+    peers proceed into a later collective would hang the job."""
+    from bigdl_tpu.parallel.collective import process_allgather_pyobj
     sharded = hasattr(dataset, "is_sharded") and dataset.is_sharded()
-    if not sharded:
+    count_fn = getattr(dataset, "process_shard_count", None)
+    idx_fn = getattr(dataset, "process_shard_index", None)
+    infos = process_allgather_pyobj(
+        (bool(sharded), count_fn() if count_fn is not None else None,
+         idx_fn() if idx_fn is not None else None))
+    nproc = jax.process_count()
+    if not all(s for s, _, _ in infos):
         raise ValueError(
             f"multi-host evaluation requires a process-sharded {what} "
-            f"(each of the {jax.process_count()} processes must hold its "
-            "own shard); an unsharded dataset would be double-counted in "
-            "the cross-host reduce")
-    count_fn = getattr(dataset, "process_shard_count", None)
-    shards = count_fn() if count_fn is not None else None
-    if shards is not None and shards != jax.process_count():
+            f"(each of the {nproc} processes must hold its own shard); "
+            "an unsharded dataset would be double-counted in the "
+            "cross-host reduce")
+    bad = {c for _, c, _ in infos if c is not None and c != nproc}
+    if bad:
         raise ValueError(
-            f"{what} was built for {shards} process shards but the job "
-            f"has {jax.process_count()} processes — the cross-host "
-            "reduce would mis-count")
+            f"{what} was built for {sorted(bad)} process shards but the "
+            f"job has {nproc} processes — the cross-host reduce would "
+            "mis-count")
+    indices = [i for _, _, i in infos if i is not None]
+    if len(indices) == len(infos) and len(set(indices)) != len(indices):
+        raise ValueError(
+            f"{what} shard indices {indices} are not distinct across "
+            "processes (every process must pass its own process_index, "
+            "not the default) — duplicated shards would be "
+            "double-counted and the rest never evaluated")
 
 
 class Optimizer:

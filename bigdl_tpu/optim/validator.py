@@ -44,6 +44,42 @@ class LocalValidator:
         return list(zip(results, methods))
 
 
+def _padded_eval(jit_fn, data_sharding, multiple, params_sharding=None):
+    """Shared eval runner: pad batches up to ``multiple``, place on
+    ``data_sharding``, trim outputs back (validation sets need not
+    divide the mesh — reference DistriValidator.scala:38-78). One home
+    for the pad/place/trim logic the single- and multi-host eval paths
+    all share (it was triplicated — round-5 review).
+
+    ``params_sharding`` (multi-host paths, where params arrive as HOST
+    trees): place params/state once per distinct tree instead of
+    re-uploading the whole model every batch. The one-slot cache keys on
+    object identity and HOLDS the keyed trees, so their ids cannot be
+    recycled while cached."""
+
+    cache = {"key": None, "placed": None}
+
+    def run(params, mstate, data):
+        if params_sharding is not None:
+            if cache["key"] is None or cache["key"][0] is not params \
+                    or cache["key"][1] is not mstate:
+                cache["key"] = (params, mstate)
+                cache["placed"] = (
+                    jax.device_put(params, params_sharding),
+                    jax.device_put(mstate, params_sharding))
+            params, mstate = cache["placed"]
+        data = np.asarray(data)
+        n = data.shape[0]
+        pad = (-n) % multiple
+        if pad:
+            data = np.concatenate([data, np.repeat(data[-1:], pad,
+                                                   axis=0)])
+        return np.asarray(jit_fn(params, mstate,
+                                 jax.device_put(data, data_sharding)))[:n]
+
+    return run
+
+
 def local_sharded_eval(apply_fn):
     """Build an eval runner sharded over THIS process's devices.
 
@@ -61,18 +97,7 @@ def local_sharded_eval(apply_fn):
     repl = NamedSharding(mesh, P())
     jit_fn = jax.jit(apply_fn, in_shardings=(repl, repl, shard),
                      out_shardings=shard)
-
-    def run(params, mstate, data):
-        data = np.asarray(data)
-        n = data.shape[0]
-        pad = (-n) % len(devs)
-        if pad:
-            data = np.concatenate([data, np.repeat(data[-1:], pad,
-                                                   axis=0)])
-        return np.asarray(jit_fn(params, mstate,
-                                 jax.device_put(data, shard)))[:n]
-
-    return run
+    return _padded_eval(jit_fn, shard, len(devs), params_sharding=repl)
 
 
 class DistriValidator:
@@ -110,21 +135,13 @@ class DistriValidator:
             out, _ = model.apply(p, s, data, training=False)
             return out
 
+        run = _padded_eval(eval_apply, self._shard, self._n_shards)
         results = [None] * len(methods)
         for batch in self.dataset.data(train=False):
-            data = np.asarray(batch.data)
-            n = data.shape[0]
-            pad = (-n) % self._n_shards
-            if pad:
-                data = np.concatenate(
-                    [data, np.repeat(data[-1:], pad, axis=0)])
-            out = eval_apply(params, mstate,
-                             jax.device_put(data, self._shard))
-            out = np.asarray(out)[:n]
-            import jax.numpy as jnp
-            labels = jnp.asarray(batch.labels)
+            out = run(params, mstate, batch.data)
+            labels = np.asarray(batch.labels)
             for i, m in enumerate(methods):
-                r = m(jnp.asarray(out), labels)
+                r = m(out, labels)
                 results[i] = r if results[i] is None else results[i] + r
         return list(zip(results, methods))
 

@@ -276,21 +276,32 @@ def test_multihost_validation_aggregates_all_hosts():
 
 
 def test_multihost_eval_guard_refuses_double_counting(monkeypatch):
-    """An unsharded (or wrong-shard-count) dataset on a multi-host job
-    would be evaluated in full by every process and double-counted by the
-    cross-host reduce — the guard must refuse both (round-5 review)."""
+    """An unsharded dataset, a wrong shard count, or duplicated shard
+    indices on a multi-host job would make the cross-host reduce
+    double-count — the guard must refuse all three (round-5 review). The
+    guard gathers every host's view FIRST so all hosts reach the same
+    verdict; here the gather is stubbed to simulate the peers."""
     import jax
 
     from bigdl_tpu.dataset.dataset import (LocalArrayDataSet,
                                            ShardedDataSet)
     from bigdl_tpu.optim.optimizer import _require_process_sharded
+    from bigdl_tpu.parallel import collective
     monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # both hosts report the same local view (e.g. default shard_index=0)
+    monkeypatch.setattr(collective, "process_allgather_pyobj",
+                        lambda obj: [obj, obj])
     with pytest.raises(ValueError, match="process-sharded"):
         _require_process_sharded(LocalArrayDataSet([1, 2]), "dataset")
     with pytest.raises(ValueError, match="2 processes"):
         _require_process_sharded(ShardedDataSet([1, 2], num_shards=1),
                                  "dataset")
-    # matching shard count passes, including through transform wrappers
+    with pytest.raises(ValueError, match="not distinct"):
+        _require_process_sharded(ShardedDataSet([1, 2], num_shards=2),
+                                 "dataset")
+    # distinct indices pass, including through transform wrappers
+    monkeypatch.setattr(collective, "process_allgather_pyobj",
+                        lambda obj: [obj, (obj[0], obj[1], 1)])
     from bigdl_tpu.dataset import Sample, SampleToBatch
     ds = ShardedDataSet([Sample(np.zeros(2), 1)] * 4, num_shards=2) \
         >> SampleToBatch(2)
