@@ -8,7 +8,9 @@ runner selects registered modules.
 MODULES = {
     "nn": ["tests/test_nn_layers.py", "tests/test_nn_layers_extended.py",
            "tests/test_criterions.py", "tests/test_recurrent.py",
-           "tests/test_gradient_check.py", "tests/test_remat.py"],
+           "tests/test_gradient_check.py", "tests/test_remat.py",
+           "tests/test_module_times.py"],
+    "kernels": ["tests/test_fused_ce.py", "tests/test_maxpool_kernel.py"],
     "tensor": ["tests/test_ref_oracle.py", "tests/test_golden_fixtures.py"],
     "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
                 "tests/test_native_loader.py"],
@@ -22,7 +24,8 @@ MODULES = {
                  "tests/test_flash_attention.py"],
     "models": ["tests/test_models.py", "tests/test_transformer.py",
                "tests/test_generate.py", "tests/test_rnn_generate.py",
-               "tests/test_perf_paths.py"],
+               "tests/test_serving.py", "tests/test_perf_paths.py"],
+    "harness": ["tests/test_bench_contract.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],
     "examples": ["tests/test_examples.py",
                  "tests/test_textclassification.py"],

@@ -8,8 +8,6 @@ re-emitted in one final aggregate line, and exit codes distinguish
 probe failure (3) from headline-row failure (2).
 """
 import json
-import os
-import sys
 
 import pytest
 

@@ -80,9 +80,9 @@ def _single_process_control():
 
 def _run_workers(mode, nproc=2):
     """Spawn ``nproc`` worker processes; return ({pid: losses},
-    {pid: metrics}, {pid: val}) parsed from their tagged output lines. Shared by
-    every multihost test (review finding: the spawn/skip/parse block was
-    triplicated)."""
+    {pid: metrics}, {pid: val}) parsed from their tagged output lines.
+    Shared by every multihost test (review finding: the spawn/skip/parse
+    block was triplicated)."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
