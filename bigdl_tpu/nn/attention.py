@@ -87,6 +87,10 @@ class MultiHeadAttention(Module):
                              f"of num_kv_heads={self.num_kv_heads}")
         self.causal = causal
         self.with_bias = with_bias
+        if sequence_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel={sequence_parallel!r} — expected "
+                "None, 'ring' or 'ulysses'")
         self.sequence_parallel = sequence_parallel
         self.mesh_axis = mesh_axis
         self.rope = rope
@@ -129,12 +133,12 @@ class MultiHeadAttention(Module):
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
         group = self.num_heads // self.num_kv_heads
-        if group > 1 and self.sequence_parallel != "ring":
-            # GQA: each kv head serves `group` query heads. The ring core
-            # takes the narrow k/v and widens per hop INSIDE the ring, so
-            # grouped blocks travel the ICI at kv width (review finding);
-            # the local/Ulysses cores need full-width heads here (the
-            # flash kernel and the Ulysses head-split assume H match)
+        if group > 1 and self.sequence_parallel is None:
+            # GQA: each kv head serves `group` query heads. The ring and
+            # Ulysses cores take the NARROW k/v and widen inside — ring
+            # per hop, Ulysses after its all_to_all — so grouped blocks
+            # travel the wire at kv width; only the local core (flash
+            # kernel assumes matching H) needs full-width heads here
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
         if self.sequence_parallel == "ring":
@@ -142,7 +146,8 @@ class MultiHeadAttention(Module):
                                    axis=self.mesh_axis, kv_groups=group)
         elif self.sequence_parallel == "ulysses":
             o = seq.ulysses_attention(q, k, v, causal=self.causal,
-                                      axis=self.mesh_axis)
+                                      axis=self.mesh_axis,
+                                      kv_groups=group)
         else:
             o = seq.dot_product_attention(q, k, v, causal=self.causal)
         y = self._proj(params, "out", o.reshape(b, s, e))

@@ -293,12 +293,22 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
 
 def ulysses_attention(q, k, v, *, causal: bool = False,
                       scale: float | None = None, axis: str = "seq",
-                      mesh: Mesh | None = None, batch_axis="auto"):
+                      mesh: Mesh | None = None, batch_axis="auto",
+                      kv_groups: int = 1):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
 
     Re-shards (B, S/N, H, D) -> (B, S, H/N, D) with one all_to_all, runs
     exact local attention over the full sequence for its head group, and
     re-shards back. Requires H % N == 0.
+
+    ``kv_groups`` > 1 (GQA): pass k/v at their NARROW kv-head width —
+    they cross the all_to_all at kv width (kv_groups-times less wire
+    traffic than pre-widened) and widen locally after the re-shard.
+    Alignment holds because head chunks are contiguous: widened
+    chunk-local head t maps to chunk-local kv head t // kv_groups,
+    which is the global h // kv_groups grouping restricted to the
+    chunk. Falls back to pre-widening when the kv heads don't divide
+    the axis (e.g. MQA on a mesh wider than the kv-head count).
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
@@ -309,6 +319,16 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             f"sequence length {q.shape[1]}/{k.shape[1]} not divisible by "
             f"mesh axis '{axis}' size {n}")
+    if kv_groups > 1:
+        if kv_groups * k.shape[2] != q.shape[2]:
+            raise ValueError(
+                f"kv_groups={kv_groups} x {k.shape[2]} kv heads != "
+                f"{q.shape[2]} query heads — pass k/v at their narrow "
+                "kv-head width (or kv_groups=1 for pre-widened)")
+        if k.shape[2] % n:
+            k = jnp.repeat(k, kv_groups, axis=2)
+            v = jnp.repeat(v, kv_groups, axis=2)
+            kv_groups = 1
 
     def body(qb, kb, vb):
         # seq-sharded -> head-sharded: split heads, gather sequence
@@ -316,6 +336,9 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
             return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
                                       tiled=True)
         qh, kh, vh = to_heads(qb), to_heads(kb), to_heads(vb)
+        if kv_groups > 1:      # widen AFTER the wire (GQA)
+            kh = jnp.repeat(kh, kv_groups, axis=2)
+            vh = jnp.repeat(vh, kv_groups, axis=2)
         out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
         # head-sharded -> seq-sharded
         return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,

@@ -114,6 +114,65 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="heads"):
             ulysses_attention(q, q, q, mesh=mesh)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gqa_narrow_kv_rides_the_wire(self, causal):
+        """kv heads divisible by the axis: k/v cross the all_to_all at
+        kv width and widen locally — values must equal the pre-widened
+        reference (chunk-local head t -> kv head t // groups alignment).
+        """
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 4},
+                           devices=jax.devices()[:4])
+        q, _, _ = _qkv(s=32, h=8, seed=8)
+        _, k, v = _qkv(s=32, h=4, seed=9)       # narrow: 4 kv heads
+        out = ulysses_attention(q, k, v, causal=causal, mesh=mesh,
+                                kv_groups=2)
+        wide_k = jnp.repeat(k, 2, axis=2)
+        wide_v = jnp.repeat(v, 2, axis=2)
+        ref = dot_product_attention(q, wide_k, wide_v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        Engine.reset()
+
+    def test_gqa_fallback_when_kv_heads_underdivide(self):
+        """MQA (1 kv head) on a 4-way axis can't head-split narrow k/v:
+        the pre-widen fallback must still be exact."""
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 4},
+                           devices=jax.devices()[:4])
+        q, _, _ = _qkv(s=32, h=8, seed=10)
+        _, k, v = _qkv(s=32, h=1, seed=11)      # multi-query
+        out = ulysses_attention(q, k, v, causal=True, mesh=mesh,
+                                kv_groups=8)
+        ref = dot_product_attention(q, jnp.repeat(k, 8, axis=2),
+                                    jnp.repeat(v, 8, axis=2), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        Engine.reset()
+
+    def test_gqa_narrow_gradients_match(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 4},
+                           devices=jax.devices()[:4])
+        q, _, _ = _qkv(b=1, s=16, h=4, d=8, seed=12)
+        _, k, v = _qkv(b=1, s=16, h=2, d=8, seed=13)
+
+        def par_loss(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, causal=True,
+                                             mesh=mesh, kv_groups=2) ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+                causal=True) ** 2)
+
+        gp = jax.grad(par_loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+        Engine.reset()
+
 
 class TestMultiHeadAttentionModule:
     def test_local_forward_and_train_step(self):
@@ -141,3 +200,22 @@ class TestMultiHeadAttentionModule:
         y_par, _ = par.apply(local.params, {}, x)
         np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_local),
                                    rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("sp", ["ring", "ulysses"])
+    def test_gqa_sequence_parallel_matches_local(self, sp):
+        """GQA composes with both sequence-parallel cores (Ulysses rides
+        narrow kv heads over the wire when they divide the axis)."""
+        Engine.reset()
+        mesh = Engine.init(axes={"seq": 4},
+                           devices=jax.devices()[:4])
+        local = nn.MultiHeadAttention(32, 8, causal=True, num_kv_heads=4)
+        local.materialize(jax.random.PRNGKey(2))
+        par = nn.MultiHeadAttention(32, 8, causal=True, num_kv_heads=4,
+                                    sequence_parallel=sp)
+        x = jnp.asarray(np.random.default_rng(8).standard_normal(
+            (2, 32, 32)).astype(np.float32))
+        y_local, _ = local.apply(local.params, {}, x)
+        y_par, _ = par.apply(local.params, {}, x)
+        np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_local),
+                                   rtol=2e-5, atol=2e-5)
+        Engine.reset()
