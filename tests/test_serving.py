@@ -18,7 +18,8 @@ import jax
 from bigdl_tpu.models import TransformerLM
 from bigdl_tpu.models.transformer.generate import (GenerationConfig,
                                                    generate)
-from bigdl_tpu.models.transformer.serving import (PagedKVCache,
+from bigdl_tpu.models.transformer.serving import (ContinuousBatcher,
+                                                  PagedKVCache,
                                                   generate_ragged,
                                                   paged_decode,
                                                   paged_prefill,
@@ -162,6 +163,81 @@ def test_paged_capacity_overflow_raises():
         paged_prefill(model, cache, table, _prompts([10]))
     with pytest.raises(ValueError, match="capacity"):
         paged_decode(model, cache, table, [2], [5], n_new=3)
+
+
+def test_continuous_batcher_matches_per_prompt_greedy():
+    """5 requests through a 2-slot batcher with a small pool: admission
+    queueing, bucketed prefill, burst decode, retirement and page
+    recycling — every result must equal the model's own per-prompt
+    greedy continuation."""
+    model = _lm(seed=6)
+    prompts = _prompts([3, 7, 5, 2, 6], seed=4)
+    cb = ContinuousBatcher(model, max_batch=2, num_pages=32, page_size=4,
+                           max_new_tokens=6, max_burst=4)
+    for i, p in enumerate(prompts):
+        cb.submit(i, p)
+    assert not cb.idle
+    results = dict(cb.run_to_completion(burst=4))
+    assert set(results) == set(range(5))
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    for i, p in enumerate(prompts):
+        want = np.asarray(generate(model, np.asarray([p], np.int32),
+                                   cfg))[0]
+        np.testing.assert_array_equal(results[i], want, err_msg=f"req {i}")
+    # every request's pages returned to the pool (scratch page stays)
+    assert cb.cache.pages_free == 32 - 1
+    assert cb.idle
+
+
+def test_continuous_batcher_eos_truncates():
+    model = _lm(seed=6)
+    p = _prompts([4], seed=5)[0]
+    cfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    want = np.asarray(generate(model, np.asarray([p], np.int32), cfg))[0]
+    eos = int(want[2])
+    first_eos = int(np.where(want == eos)[0][0])
+    cb = ContinuousBatcher(model, max_batch=1, num_pages=16, page_size=4,
+                           max_new_tokens=8, max_burst=4, eos_id=eos)
+    cb.submit("r", p)
+    results = dict(cb.run_to_completion(burst=4))
+    np.testing.assert_array_equal(results["r"], want[:first_eos + 1])
+    assert cb.cache.pages_free == 16 - 1
+
+
+def test_continuous_batcher_rejects_oversized():
+    model = _lm()          # max_len 64
+    cb = ContinuousBatcher(model, max_batch=1, num_pages=32, page_size=4,
+                           max_new_tokens=8)
+    with pytest.raises(ValueError, match="max_prompt"):
+        cb.submit("big", list(range(1, 60)))
+    with pytest.raises(ValueError, match="max_burst"):
+        cb.submit("ok", [1, 2, 3]) or cb.step(burst=99)
+
+
+def test_continuous_batcher_near_max_prompt():
+    """A prompt past the largest power of two under max_prompt (bucket
+    clamps to max_prompt, not over pages_per_slot — round-5 review)."""
+    model = _lm(seed=6)    # max_len 64 -> max_prompt 58 at max_new 6
+    prompt = _prompts([40], seed=7)[0]
+    cb = ContinuousBatcher(model, max_batch=1, num_pages=32, page_size=4,
+                           max_new_tokens=6, max_burst=4)
+    cb.submit("long", prompt)
+    results = dict(cb.run_to_completion(burst=4))
+    want = np.asarray(generate(
+        model, np.asarray([prompt], np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0)))[0]
+    np.testing.assert_array_equal(results["long"], want)
+    assert cb.cache.pages_free == 32 - 1
+
+
+def test_continuous_batcher_rejects_never_servable():
+    """A request the pool can NEVER satisfy fails at submit() instead of
+    livelocking admission (round-5 review)."""
+    model = _lm()
+    cb = ContinuousBatcher(model, max_batch=1, num_pages=8, page_size=4,
+                           max_new_tokens=8, max_burst=8)
+    with pytest.raises(ValueError, match="pool holds"):
+        cb.submit("huge", list(range(1, 17)))
 
 
 @pytest.mark.parametrize("draft_seed,expect_high",
