@@ -67,7 +67,22 @@ def _set_bf16_policy():
                            activation_dtype=jnp.bfloat16))
 
 
+def _publish_registry(row: dict):
+    """Mirror a bench row into the process-wide metric registry
+    (bigdl_tpu.observability) so bench results export beside the
+    training/serving series — one gauge per metric name."""
+    val = row.get("value")
+    if "metric" not in row or not isinstance(val, (int, float)):
+        return
+    from bigdl_tpu.observability.registry import (default_registry,
+                                                  sanitize_name)
+    default_registry().gauge(
+        "bench_" + sanitize_name(str(row["metric"])),
+        f"bench.py row (unit: {row.get('unit', '')})").set(float(val))
+
+
 def _emit(row: dict):
+    _publish_registry(row)
     print(json.dumps(row), flush=True)
 
 
@@ -585,6 +600,8 @@ def bench_decode_speculative(b: int = 32, iters: int = 10):
         "geometry": f"target 27M d512 L6 MQA, draft d128 L2 MQA, B{b} "
                     f"prompt{p_len} +{n_new} gamma={gamma}",
         "acceptance_rate": round(stats["acceptance_rate"], 4),
+        "accepted": stats["accepted"],
+        "proposed": stats["proposed"],
         "rounds": stats["rounds"],
         "acceptance_is_floor": True,   # random weights; see docstring
     }
@@ -646,6 +663,10 @@ def main(argv=None):
     parser.add_argument("--probe-timeout", type=float,
                         default=float(os.environ.get(
                             "BENCH_PROBE_TIMEOUT_S", "300")))
+    parser.add_argument("--metrics-out", default=None,
+                        help="write the metric-registry state here "
+                             "after the run (.json -> JSON dump, else "
+                             "Prometheus text exposition)")
     parser.add_argument("--host-probe", type=float, default=None,
                         help=argparse.SUPPRESS)   # subprocess entry
     args = parser.parse_args(argv)
@@ -705,6 +726,16 @@ def main(argv=None):
             if row == "headline":
                 headline_failed = True
     _emit_aggregate(rows_out)
+    if args.metrics_out:
+        from bigdl_tpu.observability.registry import default_registry
+        reg = default_registry()
+        if args.metrics_out.endswith(".json"):
+            reg.dump_json(args.metrics_out)
+        else:
+            with open(args.metrics_out, "w", encoding="utf-8") as f:
+                f.write(reg.expose())
+        print(f"# metrics registry written to {args.metrics_out}",
+              file=sys.stderr)
     if headline_failed:
         raise SystemExit(2)
 

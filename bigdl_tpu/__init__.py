@@ -16,8 +16,12 @@ jebtang/BigDL, surveyed in SURVEY.md), re-designed for TPU:
                           parameters/AllReduceParameter.scala:53-229).
 - ``bigdl_tpu.models``    LeNet, VGG, Inception v1/v2, ResNet, RNN, ...
 - ``bigdl_tpu.utils``     Table, checkpoint File IO, Torch .t7 / Caffe import.
+- ``bigdl_tpu.observability``  Metric registry, span tracer (Chrome trace
+                          JSON), Train/ValidationSummary event logs —
+                          host-only (never imports jax at module level).
 """
 
 __version__ = "0.1.0"
 
 from bigdl_tpu import nn, optim, dataset, parallel, utils, models, tensor  # noqa: F401,E402
+from bigdl_tpu import observability  # noqa: F401,E402

@@ -31,6 +31,7 @@ GQA/MQA decode path (BASELINE.md round-4: 190k tok/s) moves next.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,8 @@ import numpy as np
 from bigdl_tpu.models.transformer.generate import (
     GenerationConfig, _embed, _ffn, _linear, _ln, _logits, _model_parts,
     _proj, _sample, _split_heads)
+from bigdl_tpu.observability import trace
+from bigdl_tpu.observability.registry import default_registry
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
 __all__ = ["generate_ragged", "PagedKVCache", "paged_prefill",
@@ -556,8 +559,14 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
         return tok, probs, tuple(nck), tuple(ncv)
 
     def round_body(carry):
-        out, n_done, pos, tck, tcv, dck, dcv, acc, rounds, rng = carry
+        (out, n_done, pos, tck, tcv, dck, dcv, acc, proposed, rounds,
+         rng) = carry
         rng, r_draft, r_acc, r_bonus = jax.random.split(rng, 4)
+        # proposals only count for rows still filling their budget —
+        # finished rows keep riding the lockstep loop but their masked
+        # proposals must not deflate the acceptance rate (ADVICE.md)
+        proposed = proposed + gamma * jnp.sum(
+            (n_done < n_new).astype(jnp.int32))
         # rows already finished keep proposing into masked positions;
         # their writes land beyond max_len-1? No: clamp via mode="drop"
         # in the scatter and the emit mask below.
@@ -655,7 +664,7 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
         # too (the draft's own tokens up to the disagreement point).
         pos = pos + 1 + acc_len
         return (out, n_done, pos, tuple(ntck), tuple(ntcv), dck, dcv,
-                acc, rounds + 1, rng)
+                acc, proposed, rounds + 1, rng)
 
     def cond(carry):
         n_done = carry[1]
@@ -663,10 +672,10 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
 
     zero_acc = jnp.zeros((), jnp.int32)
     carry = (out, n_done, pos, tck, tcv, dck, dcv, zero_acc,
-             jnp.zeros((), jnp.int32), rng)
-    out, n_done, pos, _, _, _, _, acc, rounds, _ = jax.lax.while_loop(
-        cond, round_body, carry)
-    return out, acc, rounds
+             jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32), rng)
+    (out, n_done, pos, _, _, _, _, acc, proposed, rounds,
+     _) = jax.lax.while_loop(cond, round_body, carry)
+    return out, acc, proposed, rounds
 
 
 def speculative_generate(model, draft_model, prompts, *,
@@ -685,8 +694,12 @@ def speculative_generate(model, draft_model, prompts, *,
 
     ``prompts``: list of 1-based id sequences (mixed lengths ride the
     ragged path). Returns ``(tokens (B, max_new_tokens), stats)`` where
-    stats reports ``acceptance_rate`` (accepted draft tokens / proposed)
-    and ``rounds``."""
+    stats reports ``accepted`` / ``proposed`` / ``rounds`` and
+    ``acceptance_rate`` = accepted / proposed. Proposals are counted
+    only for rows still short of their token budget at each round's
+    start (rows that finished early keep riding the lockstep loop but
+    their masked proposals no longer deflate the rate — ADVICE.md,
+    mixed-progress batches)."""
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     if temperature < 0:
@@ -705,7 +718,7 @@ def speculative_generate(model, draft_model, prompts, *,
     policy_key = (str(activation_dtype()), str(compute_dtype()))
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    out, acc, rounds = _speculative_impl(
+    out, acc, proposed, rounds = _speculative_impl(
         t_params, d_params, jnp.asarray(batch), jnp.asarray(lengths),
         rng,
         t_layers=t_meta["num_layers"], t_heads=t_meta["num_heads"],
@@ -718,8 +731,9 @@ def speculative_generate(model, draft_model, prompts, *,
         n_new=max_new_tokens, gamma=gamma,
         temperature=float(temperature), policy_key=policy_key)
     rounds_i = max(int(rounds), 1)
-    proposed = rounds_i * gamma * len(prompts)
-    stats = {"acceptance_rate": float(int(acc)) / proposed,
+    proposed_i = int(proposed)
+    stats = {"acceptance_rate": float(int(acc)) / max(proposed_i, 1),
+             "accepted": int(acc), "proposed": proposed_i,
              "rounds": rounds_i}
     return out, stats
 
@@ -746,11 +760,25 @@ class ContinuousBatcher:
     and their outputs are discarded (documented demo trade-off; a
     production server would compact instead). vLLM's scheduler plays
     this role on GPU stacks; the reference has no serving story at all.
+
+    Observability (bigdl_tpu.observability): every session records into
+    a metric registry (``registry=`` — the process default unless
+    given) — ``serving_ttft_seconds`` (submit -> first token),
+    ``serving_decode_token_seconds`` (burst wall clock / burst),
+    ``serving_queue_depth`` / ``serving_active_slots`` /
+    ``serving_kv_page_utilization`` gauges, and admission / retirement
+    / token counters. ``summary=`` (any Summary) adds a per-``step()``
+    scalar event log (QueueDepth / ActiveSlots / KVPageUtilization /
+    DecodeTokensPerSec). All instrumentation is host-side around the
+    compiled programs — it adds no dispatches and no device syncs
+    beyond the token readback the loop already does (test-pinned by a
+    compile/dispatch count).
     """
 
     def __init__(self, model, *, max_batch: int, num_pages: int,
                  page_size: int = 16, max_new_tokens: int = 32,
-                 max_burst: int = 8, eos_id: int | None = None):
+                 max_burst: int = 8, eos_id: int | None = None,
+                 registry=None, summary=None):
         meta = model.lm_meta
         self.model = model
         self.max_batch = max_batch
@@ -782,6 +810,30 @@ class ContinuousBatcher:
         self._pages: list = [None] * max_batch
         self.queue: list = []
         self._done: list = []
+        self.summary = summary
+        self._step_count = 0
+        reg = default_registry() if registry is None else registry
+        self._m_queue = reg.gauge(
+            "serving_queue_depth", "requests waiting for a slot")
+        self._m_active = reg.gauge(
+            "serving_active_slots", "slots decoding this step")
+        self._m_util = reg.gauge(
+            "serving_kv_page_utilization",
+            "fraction of KV pool pages in use (incl. scratch)")
+        self._m_admit = reg.counter(
+            "serving_admissions_total", "requests admitted to a slot")
+        self._m_retire = reg.counter(
+            "serving_retirements_total",
+            "requests finished (eos or budget)")
+        self._m_tokens = reg.counter(
+            "serving_generated_tokens_total",
+            "decoded tokens kept for active rows")
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "submit -> first token (queue wait + prefill)")
+        self._m_tok_lat = reg.histogram(
+            "serving_decode_token_seconds",
+            "per-token decode latency: burst wall clock / burst")
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -811,13 +863,14 @@ class ContinuousBatcher:
                 f"request needs {self._need_pages(len(prompt))} pages "
                 f"but the pool holds {self._pool_pages} — enlarge "
                 "num_pages or shorten the prompt/budget")
-        self.queue.append((request_id, list(prompt)))
+        self.queue.append((request_id, list(prompt), time.monotonic()))
+        self._m_queue.set(len(self.queue))
 
     def _admit(self) -> None:
         for slot in range(self.max_batch):
             if self.slots[slot] is not None or not self.queue:
                 continue
-            rid, prompt = self.queue[0]
+            rid, prompt, t_submit = self.queue[0]
             bucket = min(self._bucket(len(prompt)), self.max_prompt)
             pages_needed = self._need_pages(len(prompt))
             if pages_needed > self.cache.pages_free:
@@ -835,10 +888,16 @@ class ContinuousBatcher:
             # prompt end; padding columns never write pages
             padded = np.ones((1, bucket), np.int32)
             padded[0, :len(prompt)] = prompt
-            first, _ = paged_prefill(self.model, self.cache,
-                                     row[None, :], padded,
-                                     lengths=[len(prompt)])
-            tok0 = int(np.asarray(first)[0])
+            with trace.span("prefill", cat="serving", bucket=bucket,
+                            prompt_len=len(prompt),
+                            host_sync="first-token readback"):
+                first, _ = paged_prefill(self.model, self.cache,
+                                         row[None, :], padded,
+                                         lengths=[len(prompt)])
+                tok0 = int(np.asarray(first)[0])
+            # TTFT = queue wait + prefill, closed by the readback above
+            self._m_ttft.observe(time.monotonic() - t_submit)
+            self._m_admit.inc()
             self.slots[slot] = (rid, len(prompt), [tok0])
             self.lengths[slot] = len(prompt)
             self.last[slot] = tok0
@@ -856,16 +915,31 @@ class ContinuousBatcher:
         self.table[slot] = self._scratch
         self.lengths[slot] = 0
         self.last[slot] = 1
+        self._m_retire.inc()
 
-    def step(self, burst: int = 8) -> int:
-        """Admit + decode one fixed-shape burst; returns the number of
-        ACTIVE rows that decoded."""
+    def _resolve_burst(self, burst: int | None) -> int:
+        """``None`` -> the largest default the construction allows
+        (``min(8, max_burst)`` — a ``max_burst < 8`` batcher must work
+        with no-arg calls, ADVICE.md)."""
+        if burst is None:
+            burst = min(8, self.max_burst)
         if burst > self.max_burst:
             raise ValueError(f"burst {burst} exceeds max_burst "
                              f"{self.max_burst} (page allocations carry "
                              "max_burst-1 overshoot slack)")
+        return burst
+
+    def step(self, burst: int | None = None) -> int:
+        """Admit + decode one fixed-shape burst; returns the number of
+        ACTIVE rows that decoded. ``burst=None`` resolves to
+        ``min(8, max_burst)``."""
+        burst = self._resolve_burst(burst)
         self._admit()
         active = [i for i, s in enumerate(self.slots) if s is not None]
+        self._m_queue.set(len(self.queue))
+        self._m_active.set(len(active))
+        used = self.cache.num_pages - self.cache.pages_free
+        self._m_util.set(used / self.cache.num_pages)
         if not active:
             return 0
         # free slots re-decode into the scratch page from length 0 every
@@ -873,10 +947,17 @@ class ContinuousBatcher:
         for i in range(self.max_batch):
             if self.slots[i] is None:
                 self.lengths[i] = 0
-        toks, new_len = paged_decode(self.model, self.cache, self.table,
-                                     self.lengths, self.last,
-                                     n_new=burst)
-        toks = np.asarray(toks)
+        t0 = time.monotonic()
+        with trace.span("decode burst", cat="serving", burst=burst,
+                        active=len(active),
+                        host_sync="token readback"):
+            toks, new_len = paged_decode(self.model, self.cache,
+                                         self.table, self.lengths,
+                                         self.last, n_new=burst)
+            toks = np.asarray(toks)
+        dt = time.monotonic() - t0
+        self._m_tok_lat.observe(dt / burst)
+        self._m_tokens.inc(len(active) * burst)
         self.lengths = np.asarray(new_len, np.int32).copy()
         for i in active:
             rid, plen, got = self.slots[i]
@@ -887,6 +968,18 @@ class ContinuousBatcher:
                        and self.eos_id in got[:self.max_new])
             if hit_eos or len(got) >= self.max_new:
                 self._retire(i)
+        self._step_count += 1
+        used = self.cache.num_pages - self.cache.pages_free
+        self._m_util.set(used / self.cache.num_pages)
+        self._m_active.set(sum(s is not None for s in self.slots))
+        if self.summary is not None:
+            s, n = self.summary, self._step_count
+            s.add_scalar("ActiveSlots", len(active), n)
+            s.add_scalar("QueueDepth", len(self.queue), n)
+            s.add_scalar("KVPageUtilization",
+                         used / self.cache.num_pages, n)
+            s.add_scalar("DecodeTokensPerSec",
+                         len(active) * burst / max(dt, 1e-9), n)
         return len(active)
 
     def finished(self):
@@ -898,8 +991,10 @@ class ContinuousBatcher:
     def idle(self) -> bool:
         return not self.queue and all(s is None for s in self.slots)
 
-    def run_to_completion(self, burst: int = 8, max_steps: int = 10000):
-        """Drive step() until every submitted request finishes."""
+    def run_to_completion(self, burst: int | None = None,
+                          max_steps: int = 10000):
+        """Drive step() until every submitted request finishes.
+        ``burst=None`` resolves to ``min(8, max_burst)`` per step."""
         steps = 0
         while not self.idle:
             self.step(burst)

@@ -1,0 +1,38 @@
+"""bigdl_tpu.observability — traces, metrics, and summaries.
+
+Host-side observability spanning training and serving (reference
+parity: the named per-iteration ``Metrics`` + per-module timing hooks,
+SURVEY §2.7/§7, grown into the BigDL line's TrainSummary/
+ValidationSummary visualization API — arXiv:1804.05839, 2204.01715).
+Three pillars:
+
+- ``registry``  — process-wide Counter/Gauge/Histogram registry with
+  Prometheus text exposition and a JSON dump
+  (:func:`default_registry`).
+- ``trace``     — span tracer (``trace.span("device step")``) that
+  exports Chrome trace-event JSON for chrome://tracing / Perfetto,
+  with explicit host-sync annotations.
+- ``summary``   — TrainSummary/ValidationSummary scalar event logs
+  (JSONL) plus :class:`SummaryReader` for replay.
+
+HOST-ONLY CONTRACT: nothing in this package imports jax at module top
+level (dev/lint.py enforces it) and nothing here blocks on a device
+value — instrumentation wraps compiled steps from the outside, so
+enabling observability never changes what XLA compiles or when the
+host syncs (pinned by tests/test_observability.py compile/dispatch
+counts).
+"""
+from bigdl_tpu.observability import tracing as trace  # noqa: F401
+from bigdl_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                              MetricRegistry,
+                                              default_registry,
+                                              sanitize_name)
+from bigdl_tpu.observability.summary import (Summary, SummaryReader,
+                                             TrainSummary,
+                                             ValidationSummary)
+from bigdl_tpu.observability.tracing import Tracer
+
+__all__ = ["trace", "Tracer", "Counter", "Gauge", "Histogram",
+           "MetricRegistry", "default_registry", "sanitize_name",
+           "Summary", "TrainSummary", "ValidationSummary",
+           "SummaryReader"]

@@ -1,0 +1,312 @@
+"""Process-wide metric registry: Counter / Gauge / Histogram.
+
+The operator-facing measurement substrate (reference parity: the named
+per-iteration ``Metrics`` accumulators, optim/Metrics.scala:24-117, grown
+the way the BigDL line grew them into first-class visualization tooling —
+arXiv:1804.05839 §5, arXiv:2204.01715). Three instrument kinds:
+
+- :class:`Counter`   — monotonically increasing totals (admissions,
+  retirements, tokens generated).
+- :class:`Gauge`     — last-write-wins level readings (queue depth, KV
+  page-pool utilization, collective bytes per step).
+- :class:`Histogram` — FIXED bucket boundaries chosen at registration
+  (latency distributions: step time, TTFT, per-token decode latency).
+  Fixed boundaries keep merges/exposition O(buckets) and allocation-free
+  per observation.
+
+Instruments carry optional label dimensions; ``(name, label values)``
+identifies a time series. Exposition: :meth:`MetricRegistry.expose`
+emits Prometheus text format; :meth:`MetricRegistry.dump` a JSON-able
+dict (same data, for harnesses that want structured output).
+
+HOST-ONLY CONTRACT: this module never imports jax (enforced by
+dev/lint.py) and every operation is a lock + dict update on host memory
+— safe to call at any frequency from training/serving loops, and
+incapable of adding a device sync to a compiled step.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "default_registry", "sanitize_name", "DEFAULT_BUCKETS"]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# seconds-oriented latency boundaries: 0.5ms .. 10s (+Inf implicit)
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def sanitize_name(name: str) -> str:
+    """Map an arbitrary display name ("device step time") onto the
+    exposition charset (``device_step_time``)."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name).strip())
+    if not out or not _NAME_RE.match(out):
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames=()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r} "
+                             "(use sanitize_name)")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: dict[tuple, object] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[ln]) for ln in self.labelnames)
+
+    def _labelstr(self, key: tuple, extra: str = "") -> str:
+        parts = [f'{ln}="{_escape(v)}"'
+                 for ln, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    """Monotonic total. ``inc`` only; negative increments are a bug."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc {amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def value(self, **labels) -> float:
+        return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    """Level reading; last write wins."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return float(self._series.get(self._key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Distribution with FIXED bucket boundaries (upper bounds,
+    cumulative in exposition; +Inf implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(set(bs)) or bs[-1] == math.inf:
+            raise ValueError(
+                f"histogram {name} buckets must be strictly increasing "
+                f"finite upper bounds, got {buckets}")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels):
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = {"counts": [0] * (len(self.buckets) + 1),
+                      "sum": 0.0, "count": 0}
+                self._series[key] = st
+            i = 0
+            while i < len(self.buckets) and v > self.buckets[i]:
+                i += 1
+            st["counts"][i] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    def snapshot(self, **labels) -> dict:
+        """Cumulative per-bucket counts plus sum/count:
+        ``{"buckets": {le_str: n}, "sum": s, "count": n}``."""
+        key = self._key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                return {"buckets": {}, "sum": 0.0, "count": 0}
+            counts = list(st["counts"])
+            total = float(st["sum"])
+            n = int(st["count"])
+        cum, out = 0, {}
+        for b, c in zip(self.buckets + (math.inf,), counts):
+            cum += c
+            out[_fmt(b)] = cum
+        return {"buckets": out, "sum": total, "count": n}
+
+
+class MetricRegistry:
+    """Name -> instrument map with idempotent get-or-create and text /
+    JSON exposition. One process-wide default lives behind
+    :func:`default_registry`; tests construct their own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}, requested {cls.kind}")
+                if m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} labelnames {m.labelnames} != "
+                        f"requested {tuple(labelnames)}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def _collect(self):
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        for m in self._collect():
+            if m.help:
+                lines.append(f"# HELP {m.name} {_escape(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            with m._lock:
+                series = dict(m._series)
+            for key in sorted(series):
+                if isinstance(m, Histogram):
+                    st = series[key]
+                    cum = 0
+                    for b, c in zip(m.buckets + (math.inf,),
+                                    st["counts"]):
+                        cum += c
+                        lbl = m._labelstr(key,
+                                          f'le="{_fmt(b)}"')
+                        lines.append(f"{m.name}_bucket{lbl} {cum}")
+                    lines.append(f"{m.name}_sum{m._labelstr(key)} "
+                                 f"{_fmt(st['sum'])}")
+                    lines.append(f"{m.name}_count{m._labelstr(key)} "
+                                 f"{st['count']}")
+                else:
+                    lines.append(f"{m.name}{m._labelstr(key)} "
+                                 f"{_fmt(series[key])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump(self) -> dict:
+        """JSON-able mirror of :meth:`expose`."""
+        out = {}
+        for m in self._collect():
+            samples = []
+            with m._lock:
+                series = dict(m._series)
+            for key in sorted(series):
+                labels = dict(zip(m.labelnames, key))
+                if isinstance(m, Histogram):
+                    st = series[key]
+                    cum, buckets = 0, {}
+                    for b, c in zip(m.buckets + (math.inf,),
+                                    st["counts"]):
+                        cum += c
+                        buckets[_fmt(b)] = cum
+                    samples.append({"labels": labels,
+                                    "buckets": buckets,
+                                    "sum": float(st["sum"]),
+                                    "count": int(st["count"])})
+                else:
+                    samples.append({"labels": labels,
+                                    "value": float(series[key])})
+            out[m.name] = {"type": m.kind, "help": m.help,
+                           "labelnames": list(m.labelnames),
+                           "samples": samples}
+        return out
+
+    def dump_json(self, path: str | None = None) -> str:
+        text = json.dumps(self.dump(), indent=2, sort_keys=True)
+        if path is not None:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+        return text
+
+
+_DEFAULT = MetricRegistry()
+
+
+def default_registry() -> MetricRegistry:
+    """The process-wide registry every subsystem records into by
+    default (pass ``registry=`` to instrumented components to
+    isolate)."""
+    return _DEFAULT

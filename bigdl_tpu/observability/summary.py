@@ -1,0 +1,130 @@
+"""TrainSummary / ValidationSummary — portable scalar event logs.
+
+Reference-parity naming: the BigDL line's visualization API
+(``TrainSummary`` / ``ValidationSummary``, arXiv:1804.05839 §5;
+"BigDL 2.0" arXiv:2204.01715) records per-step scalars the operator
+replays in a dashboard. Instead of TF event protos the log here is
+PORTABLE JSONL: one ``{"step", "wall_time", "tag", "value"}`` object
+per line, append-only, flushed per write — readable with one
+``json.loads`` per line from any language, and safe to tail while the
+run is live.
+
+Writers take HOST floats (the training loop has already paid the
+``float(loss)`` sync it needed anyway); a summary never forces a
+device readback of its own. :class:`SummaryReader` replays a log into
+per-tag ``(step, wall_time, value)`` series for plotting/regression
+checks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Summary", "TrainSummary", "ValidationSummary",
+           "SummaryReader"]
+
+
+class Summary:
+    """Append-only scalar event log at ``{log_dir}/{app_name}/
+    {kind}.jsonl``. Subclasses fix ``kind``; the base class is usable
+    directly for ad-hoc logs (e.g. a serving session)."""
+
+    kind = "events"
+
+    def __init__(self, log_dir: str, app_name: str = "bigdl"):
+        self.log_dir = log_dir
+        self.app_name = app_name
+        d = os.path.join(log_dir, app_name)
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, f"{self.kind}.jsonl")
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        """Append one ``(step, wall_time, tag, value)`` event.
+        ``value`` must already be a host number — pass ``float(loss)``,
+        never a live device array."""
+        rec = {"step": int(step), "wall_time": time.time(),
+               "tag": str(tag), "value": float(value)}
+        line = json.dumps(rec)
+        with self._lock:
+            if self._f.closed:
+                raise ValueError(f"summary {self.path} is closed")
+            self._f.write(line + "\n")
+            self._f.flush()
+        return self
+
+    def read_scalar(self, tag: str) -> list[tuple[int, float, float]]:
+        """Replay this log's series for ``tag`` (see
+        :meth:`SummaryReader.scalars`)."""
+        return SummaryReader(self.path).scalars(tag)
+
+    def tags(self) -> list[str]:
+        return SummaryReader(self.path).tags()
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class TrainSummary(Summary):
+    """Per-iteration training scalars (Loss / Throughput /
+    HostInputTime / DeviceStepTime, plus whatever callers add)."""
+
+    kind = "train"
+
+
+class ValidationSummary(Summary):
+    """Validation scalars, one event per method per validation pass."""
+
+    kind = "validation"
+
+
+class SummaryReader:
+    """Replay a summary JSONL log (pass the ``.jsonl`` path or a
+    summary object's ``.path``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def records(self) -> list[dict]:
+        out = []
+        with open(self.path, encoding="utf-8") as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{self.path}:{ln}: corrupt summary line "
+                        f"({e})") from e
+                out.append(rec)
+        return out
+
+    def tags(self) -> list[str]:
+        return sorted({r["tag"] for r in self.records()})
+
+    def scalars(self, tag: str) -> list[tuple[int, float, float]]:
+        """``[(step, wall_time, value), ...]`` in file (= write)
+        order."""
+        return [(int(r["step"]), float(r["wall_time"]),
+                 float(r["value"]))
+                for r in self.records() if r["tag"] == tag]
+
+    def steps(self, tag: str) -> list[int]:
+        return [s for s, _, _ in self.scalars(tag)]
+
+    def values(self, tag: str) -> list[float]:
+        return [v for _, _, v in self.scalars(tag)]

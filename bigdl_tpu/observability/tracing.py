@@ -1,0 +1,204 @@
+"""Span tracer exporting Chrome trace-event JSON (Perfetto-loadable).
+
+``trace.span("device step")`` wraps a HOST phase in a complete ("X")
+trace event; :meth:`Tracer.export` writes the standard
+``{"traceEvents": [...]}`` JSON that chrome://tracing and
+https://ui.perfetto.dev open directly (SURVEY §2.7 per-module timing
+hooks, rebuilt for the XLA era where per-op host timers cannot see
+inside a compiled step).
+
+THE NO-SYNC CONTRACT. Spans read ``time.monotonic()`` and append to a
+host list — nothing else. They must wrap code OUTSIDE jitted functions
+(dispatch, host input, readback); they never call ``block_until_ready``
+and never make a span boundary force one. Where the surrounding loop
+*intentionally* blocks on a device value (``float(loss)``,
+``np.asarray(tokens)``), pass ``host_sync="why"`` to :meth:`span` or
+call :meth:`host_sync` so the sync is EXPLICIT in the trace instead of
+an invisible stall. dev/lint.py enforces that this package never
+imports jax at module top level.
+
+A process-wide tracer (disabled by default — disabled spans are a
+single attribute check) sits behind module-level ``span`` / ``instant``
+/ ``counter`` / ``enable`` / ``export`` so call sites just do::
+
+    from bigdl_tpu.observability import trace
+    with trace.span("device step", host_sync="loss readback"):
+        ...
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["Tracer", "get_tracer", "set_tracer", "enable", "disable",
+           "enabled", "span", "instant", "counter", "host_sync",
+           "export", "to_dict", "clear"]
+
+
+class Tracer:
+    """Thread-safe event buffer on monotonic clocks. ``ts`` is
+    microseconds since tracer creation; ``pid``/``tid`` identify the
+    emitting process/thread; the buffer is bounded (drops counted, not
+    grown) so an unattended server can leave tracing on."""
+
+    def __init__(self, max_events: int = 1_000_000,
+                 enabled: bool = False):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._max = max_events
+        self._enabled = bool(enabled)
+        self._t0 = time.monotonic()
+        self._pid = os.getpid()
+
+    # -- lifecycle --
+    def enable(self):
+        self._enabled = True
+        return self
+
+    def disable(self):
+        self._enabled = False
+        return self
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+        return self
+
+    # -- recording --
+    def _now_us(self) -> float:
+        return (time.monotonic() - self._t0) * 1e6
+
+    def _emit(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """Complete-event context manager. Extra kwargs land in the
+        event's ``args`` (use ``host_sync="why"`` to mark that the
+        wrapped code intentionally blocks on a device value)."""
+        if not self._enabled:
+            yield
+            return
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            t1 = self._now_us()
+            ev = {"name": name, "cat": cat, "ph": "X", "ts": t0,
+                  "dur": t1 - t0, "pid": self._pid,
+                  "tid": threading.get_ident()}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+
+    def instant(self, name: str, cat: str = "host", **args):
+        if not self._enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self._now_us(), "pid": self._pid,
+              "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def counter(self, name: str, value: float, cat: str = "host"):
+        """Counter-track event (renders as a value-over-time track)."""
+        if not self._enabled:
+            return
+        self._emit({"name": name, "cat": cat, "ph": "C",
+                    "ts": self._now_us(), "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    "args": {"value": float(value)}})
+
+    def host_sync(self, what: str, **args):
+        """Annotate an INTENTIONAL host<-device sync point (loss
+        readback, token fetch). Also counts into the default registry's
+        ``trace_host_syncs_total`` so a sync added to a hot loop shows
+        up in metrics even with tracing off."""
+        from bigdl_tpu.observability.registry import default_registry
+        default_registry().counter(
+            "trace_host_syncs_total",
+            "intentional host<-device sync annotations").inc()
+        self.instant(what, cat="host_sync", **args)
+
+    # -- export --
+    def to_dict(self) -> dict:
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": dropped,
+                              "clock": "monotonic_us"}}
+
+    def export(self, path: str) -> str:
+        """Write Chrome trace JSON; open in chrome://tracing or
+        ui.perfetto.dev. Returns ``path``."""
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f)
+        return path
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    global _TRACER
+    _TRACER = tracer
+    return tracer
+
+
+def enable():
+    return _TRACER.enable()
+
+
+def disable():
+    return _TRACER.disable()
+
+
+def enabled() -> bool:
+    return _TRACER.enabled
+
+
+def span(name: str, cat: str = "host", **args):
+    return _TRACER.span(name, cat=cat, **args)
+
+
+def instant(name: str, cat: str = "host", **args):
+    return _TRACER.instant(name, cat=cat, **args)
+
+
+def counter(name: str, value: float, cat: str = "host"):
+    return _TRACER.counter(name, value, cat=cat)
+
+
+def host_sync(what: str, **args):
+    return _TRACER.host_sync(what, **args)
+
+
+def export(path: str) -> str:
+    return _TRACER.export(path)
+
+
+def to_dict() -> dict:
+    return _TRACER.to_dict()
+
+
+def clear():
+    return _TRACER.clear()

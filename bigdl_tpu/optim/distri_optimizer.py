@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.observability import trace
 from bigdl_tpu.optim.optimizer import Optimizer, _clip_gradients
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 
@@ -240,35 +241,38 @@ class DistriOptimizer(Optimizer):
             driver_state["is_epoch_end"] = False
             self._profile_hook(driver_state["neval"])
             t0 = time.perf_counter()
-            batch = next(data_iter)
-            if isinstance(batch.data, jax.Array):
-                # DevicePrefetcher already placed the batch (overlapped
-                # with the previous device step) — don't round-trip it
-                data, labels = batch.data, batch.labels
-                global_n = data.shape[0]
-                needs_shard = False
-            else:
-                data = np.asarray(batch.data)
-                labels = np.asarray(batch.labels)
-                global_n = data.shape[0] * jax.process_count()
-                needs_shard = True
-            if global_n % batch_div != 0:
-                # a mesh-sharded DevicePrefetcher raised this before
-                # placement; this covers host batches, sharding-less
-                # prefetchers, and user-placed arrays
-                raise ValueError(
-                    f"global batch {global_n} not divisible by the "
-                    f"{batch_div} data-axis shards (reference "
-                    "Utils.getBatchSize divisibility requirement, "
-                    "dataset/Utils.scala:25-47)")
-            if sp_size > 1 and data.shape[1] % sp_size != 0:
-                raise ValueError(
-                    f"sequence length {data.shape[1]} not divisible by "
-                    f"the {sp_size}-way '{sp_axis}' mesh axis "
-                    "(sequence_parallel shards batch dim 1)")
-            if needs_shard:
-                data, labels = self._shard_batch(data, labels, batch_shard,
-                                                 label_shard)
+            with trace.span("host input"):
+                batch = next(data_iter)
+                if isinstance(batch.data, jax.Array):
+                    # DevicePrefetcher already placed the batch
+                    # (overlapped with the previous device step) —
+                    # don't round-trip it
+                    data, labels = batch.data, batch.labels
+                    global_n = data.shape[0]
+                    needs_shard = False
+                else:
+                    data = np.asarray(batch.data)
+                    labels = np.asarray(batch.labels)
+                    global_n = data.shape[0] * jax.process_count()
+                    needs_shard = True
+                if global_n % batch_div != 0:
+                    # a mesh-sharded DevicePrefetcher raised this before
+                    # placement; this covers host batches, sharding-less
+                    # prefetchers, and user-placed arrays
+                    raise ValueError(
+                        f"global batch {global_n} not divisible by the "
+                        f"{batch_div} data-axis shards (reference "
+                        "Utils.getBatchSize divisibility requirement, "
+                        "dataset/Utils.scala:25-47)")
+                if sp_size > 1 and data.shape[1] % sp_size != 0:
+                    raise ValueError(
+                        f"sequence length {data.shape[1]} not divisible "
+                        f"by the {sp_size}-way '{sp_axis}' mesh axis "
+                        "(sequence_parallel shards batch dim 1)")
+                if needs_shard:
+                    data, labels = self._shard_batch(data, labels,
+                                                     batch_shard,
+                                                     label_shard)
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
@@ -276,16 +280,20 @@ class DistriOptimizer(Optimizer):
             shape_key = (data.shape, labels.shape)
             compiled_this_iter = shape_key not in compiled_steps
             if compiled_this_iter:
-                compiled = jit_step.lower(
-                    params, mstate, opt_state, step_rng, data, labels,
-                    epoch_arr).compile()
+                with trace.span("compile step",
+                                shape=str(shape_key)):
+                    compiled = jit_step.lower(
+                        params, mstate, opt_state, step_rng, data,
+                        labels, epoch_arr).compile()
                 if not compiled_steps:
                     self._account_collectives(compiled, n_shards)
                 compiled_steps[shape_key] = compiled
-            params, mstate, opt_state, loss = compiled_steps[shape_key](
-                params, mstate, opt_state, step_rng, data, labels,
-                epoch_arr)
-            loss = float(loss)
+            with trace.span("device step", host_sync="loss readback"):
+                params, mstate, opt_state, loss = \
+                    compiled_steps[shape_key](
+                        params, mstate, opt_state, step_rng, data,
+                        labels, epoch_arr)
+                loss = float(loss)
             t2 = time.perf_counter()
             device_time = t2 - t1
             step_time = t2 - t0
@@ -304,8 +312,8 @@ class DistriOptimizer(Optimizer):
             # honest phase metrics: the reference's get-weights/compute/
             # aggregate phases fuse inside the jitted step, so what's
             # measurable is host input vs device step (see metrics.py)
-            self.metrics.record("device step time", device_time)
-            self.metrics.record("host input time", data_time)
+            self._record_step(driver_state["neval"], loss, n, step_time,
+                              data_time, device_time)
             wire = self.metrics.get("collective wire bytes per chip per step")
             if wire > 0 and not compiled_this_iter:
                 # device step time >= collective time, so this is a LOWER

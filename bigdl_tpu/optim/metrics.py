@@ -22,34 +22,72 @@ report the distribution (mean/p50/p95/max) — the SPMD replacement for the
 reference's straggler diagnostics (per-replica time table,
 DistriOptimizer.scala:249-277): lockstep collectives can't drop members,
 but a fat tail in step time is still the signal an operator looks for.
+
+Registry shim: every ``set``/``add``/``record`` also lands in a
+``bigdl_tpu.observability`` metric registry (the process-wide default
+unless ``registry=`` is given) — ``set`` -> Gauge, ``add`` ->
+``*_total`` Counter, ``record`` -> Histogram — so optimizer metrics
+export through the same Prometheus/JSON surface as serving and bench
+metrics. The per-name series stays HERE (exact percentiles +
+:meth:`aggregated`'s cross-host merge need raw values, which fixed
+histogram buckets deliberately discard); the registry carries the
+operator-facing view.
 """
 from __future__ import annotations
 
 import threading
 from collections import defaultdict, deque
 
+from bigdl_tpu.observability.registry import default_registry, sanitize_name
+
 __all__ = ["Metrics"]
 
 
 class Metrics:
-    def __init__(self, keep: int = 4096):
+    def __init__(self, keep: int = 4096, registry=None,
+                 namespace: str = "bigdl"):
         self._lock = threading.Lock()
         self._scalars: dict[str, float] = {}
         self._counts: dict[str, int] = defaultdict(int)
         self._distributed: dict[str, list] = {}
         self._series: dict[str, deque] = {}
         self._keep = keep
+        self._ns = namespace
+        self._registry = registry if registry is not None \
+            else default_registry()
+
+    def _mirror(self, kind: str, name: str, value: float) -> None:
+        """Best-effort registry export; observability must never break
+        training (e.g. a display name that sanitizes onto a metric
+        already registered as a different kind)."""
+        mname = f"{self._ns}_{sanitize_name(name)}"
+        try:
+            if kind == "gauge":
+                self._registry.gauge(
+                    mname, f"Metrics scalar '{name}'").set(value)
+            elif kind == "counter":
+                if value >= 0:
+                    self._registry.counter(
+                        f"{mname}_total",
+                        f"Metrics accumulator '{name}'").inc(value)
+            else:
+                self._registry.histogram(
+                    mname, f"Metrics series '{name}'").observe(value)
+        except ValueError:
+            pass
 
     def set(self, name: str, value: float, parallel: int = 1):
         """(reference Metrics.set)"""
         with self._lock:
             self._scalars[name] = float(value) / parallel
+        self._mirror("gauge", name, float(value) / parallel)
 
     def add(self, name: str, value: float):
         """(reference Metrics.add on accumulators)"""
         with self._lock:
             self._scalars[name] = self._scalars.get(name, 0.0) + float(value)
             self._counts[name] += 1
+        self._mirror("counter", name, float(value))
 
     def set_distributed(self, name: str, values):
         with self._lock:
@@ -65,6 +103,7 @@ class Metrics:
             if name not in self._series:
                 self._series[name] = deque(maxlen=self._keep)
             self._series[name].append(float(value))
+        self._mirror("histogram", name, float(value))
 
     def stats(self, name: str) -> dict:
         """Distribution of a recorded series: n/mean/p50/p95/max."""

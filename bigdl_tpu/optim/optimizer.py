@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import (to_jax_batch)
+from bigdl_tpu.observability import trace
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod
 from bigdl_tpu.optim.sgd import SGD
@@ -126,6 +127,8 @@ class Optimizer:
         self._profiling = False
         self.grad_clip = None
         self.input_transform = None
+        self.train_summary = None
+        self.val_summary = None
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -173,6 +176,22 @@ class Optimizer:
         self.optim_method = method
         return self
 
+    def set_train_summary(self, summary):
+        """Per-iteration scalar event log (reference-parity
+        ``TrainSummary``, observability/summary.py): the loop appends
+        Loss / Throughput / HostInputTime / DeviceStepTime at every
+        step. Host floats only — recording never adds a device sync the
+        loop wasn't already paying. Returns self."""
+        self.train_summary = summary
+        return self
+
+    def set_val_summary(self, summary):
+        """``ValidationSummary`` event log: one scalar per validation
+        method per validation pass, tagged by the method's repr, plus
+        ValidationThroughput. Returns self."""
+        self.val_summary = summary
+        return self
+
     def set_input_transform(self, fn):
         """Pure function applied to each batch's DATA inside the jitted
         train/eval step — the hook the u8 input pipeline uses to run
@@ -195,6 +214,22 @@ class Optimizer:
         """(reference Optimizer.header, Optimizer.scala:131-134)"""
         return f"[Epoch {epoch} {count}/{total}][Iteration {neval}]" \
                f"[Wall Clock {wallclock:.3f}s]"
+
+    def _record_step(self, neval: int, loss: float, n: int,
+                     step_time: float, data_time: float,
+                     device_time: float) -> None:
+        """Shared per-iteration observability: the honest host-side
+        phase split into Metrics (-> registry histograms) plus the
+        TrainSummary event log. Called AFTER the step's own
+        ``float(loss)`` sync — everything here is host arithmetic."""
+        self.metrics.record("device step time", device_time)
+        self.metrics.record("host input time", data_time)
+        if self.train_summary is not None:
+            s = self.train_summary
+            s.add_scalar("Loss", loss, neval)
+            s.add_scalar("Throughput", n / max(step_time, 1e-9), neval)
+            s.add_scalar("HostInputTime", data_time, neval)
+            s.add_scalar("DeviceStepTime", device_time, neval)
 
     def _validate(self, apply_fn, params, mstate, driver_state, *,
                   fire: bool | None = None):
@@ -220,13 +255,15 @@ class Optimizer:
         results = [None] * len(self.validation_methods)
         count = 0
         t0 = time.perf_counter()
-        for batch in self.validation_dataset.data(train=False):
-            data, labels = to_jax_batch(batch)
-            out = apply_fn(params, mstate, data)
-            count += data.shape[0]
-            for i, m in enumerate(self.validation_methods):
-                r = m(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
+        with trace.span("validation", host_sync="per-batch metric eval"):
+            for batch in self.validation_dataset.data(train=False):
+                data, labels = to_jax_batch(batch)
+                out = apply_fn(params, mstate, data)
+                count += data.shape[0]
+                for i, m in enumerate(self.validation_methods):
+                    r = m(out, labels)
+                    results[i] = r if results[i] is None \
+                        else results[i] + r
         if jax.process_count() > 1:
             # each process validated its own shard; reduce to the global
             # result on every host (reference DistriValidator's driver
@@ -242,6 +279,14 @@ class Optimizer:
                     f"{count / max(elapsed, 1e-9):.2f} records/second")
         for m, r in zip(self.validation_methods, results):
             logger.info(f"{m!r} is {r!r}")
+        if self.val_summary is not None:
+            step = int(driver_state.get("neval", 0))
+            for m, r in zip(self.validation_methods, results):
+                self.val_summary.add_scalar(repr(m),
+                                            float(r.result()[0]), step)
+            self.val_summary.add_scalar(
+                "ValidationThroughput",
+                count / max(elapsed, 1e-9), step)
         return dict(zip([repr(m) for m in self.validation_methods], results))
 
     @staticmethod
@@ -430,15 +475,17 @@ class LocalOptimizer(Optimizer):
             driver_state["is_epoch_end"] = False
             self._profile_hook(driver_state["neval"])
             t0 = time.perf_counter()
-            batch = next(data_iter)
-            data, labels = to_jax_batch(batch)
+            with trace.span("host input"):
+                batch = next(data_iter)
+                data, labels = to_jax_batch(batch)
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
-            params, mstate, opt_state, loss = jit_step(
-                params, mstate, opt_state, step_rng, data, labels,
-                jnp.asarray(driver_state["epoch"], jnp.int32))
-            loss = float(loss)  # blocks; keeps host loop in lockstep
+            with trace.span("device step", host_sync="loss readback"):
+                params, mstate, opt_state, loss = jit_step(
+                    params, mstate, opt_state, step_rng, data, labels,
+                    jnp.asarray(driver_state["epoch"], jnp.int32))
+                loss = float(loss)  # blocks; keeps host loop in lockstep
             t2 = time.perf_counter()
             device_time = t2 - t1
             step_time = t2 - t0
@@ -454,8 +501,8 @@ class LocalOptimizer(Optimizer):
                 f" host input time is {data_time:.4f}s, device step time is "
                 f"{device_time:.4f}s, "
                 f"throughput is {n / max(step_time, 1e-9):.2f} records/second")
-            self.metrics.record("device step time", device_time)
-            self.metrics.record("host input time", data_time)
+            self._record_step(driver_state["neval"], loss, n, step_time,
+                              data_time, device_time)
             driver_state["neval"] += 1
             if count_this_epoch >= epoch_size:
                 driver_state["epoch"] += 1

@@ -16,6 +16,15 @@ __all__ = ["Validator", "LocalValidator", "DistriValidator",
            "local_sharded_eval"]
 
 
+def _record_validation(summary, results, methods, step: int) -> None:
+    """Append each method's scalar to a ValidationSummary event log
+    (observability/summary.py), tagged by the method's repr."""
+    if summary is None:
+        return
+    for m, r in zip(methods, results):
+        summary.add_scalar(repr(m), float(r.result()[0]), int(step))
+
+
 class LocalValidator:
     """(reference optim/LocalValidator.scala — per-core clones collapse
     into one jitted eval fn)"""
@@ -24,7 +33,9 @@ class LocalValidator:
         self.model = model
         self.dataset = dataset
 
-    def test(self, methods):
+    def test(self, methods, *, summary=None, step: int = 0):
+        """``summary``/``step``: optionally append each method's scalar
+        to a ValidationSummary event log at ``step``."""
         model = self.model
         model.materialize()
         model.evaluate()
@@ -41,6 +52,7 @@ class LocalValidator:
             for i, m in enumerate(methods):
                 r = m(out, labels)
                 results[i] = r if results[i] is None else results[i] + r
+        _record_validation(summary, results, methods, step)
         return list(zip(results, methods))
 
 
@@ -55,7 +67,16 @@ def _padded_eval(jit_fn, data_sharding, multiple, params_sharding=None):
     trees): place params/state once per distinct tree instead of
     re-uploading the whole model every batch. The one-slot cache keys on
     object identity and HOLDS the keyed trees, so their ids cannot be
-    recycled while cached."""
+    recycled while cached.
+
+    CACHING CONTRACT — params trees are immutable: because the cache
+    keys on the ROOT objects' identity, a caller that mutates a
+    params/mstate tree IN PLACE between calls (same dict, new leaves)
+    would silently evaluate against the stale device-placed copies.
+    Every current caller passes fresh ``_to_host`` trees per validation
+    pass, which satisfies the contract by construction; if you hold a
+    tree across calls, treat it as frozen — build a new dict to change
+    it."""
 
     cache = {"key": None, "placed": None}
 
@@ -121,9 +142,12 @@ class DistriValidator:
         self._shard = data_sharding(self.mesh)
         self._n_shards = int(np.prod(self.mesh.devices.shape))
 
-    def test(self, methods):
+    def test(self, methods, *, summary=None, step: int = 0):
+        """``summary``/``step``: optionally append each method's scalar
+        to a ValidationSummary event log at ``step``."""
         if jax.process_count() > 1:
-            return self._test_multihost(methods)
+            return self._test_multihost(methods, summary=summary,
+                                        step=step)
         model = self.model
         model.materialize()
         model.evaluate()
@@ -143,9 +167,10 @@ class DistriValidator:
             for i, m in enumerate(methods):
                 r = m(out, labels)
                 results[i] = r if results[i] is None else results[i] + r
+        _record_validation(summary, results, methods, step)
         return list(zip(results, methods))
 
-    def _test_multihost(self, methods):
+    def _test_multihost(self, methods, *, summary=None, step: int = 0):
         """Multi-host evaluation: each process maps over ITS OWN dataset
         shard on its local devices (the reference's executor-local map),
         then the results monoid-reduce across hosts (the driver reduce,
@@ -175,7 +200,9 @@ class DistriValidator:
             for i, m in enumerate(methods):
                 r = m(out, labels)
                 results[i] = r if results[i] is None else results[i] + r
-        return list(zip(aggregate_results(results), methods))
+        merged = aggregate_results(results)
+        _record_validation(summary, merged, methods, step)
+        return list(zip(merged, methods))
 
 
 def Validator(model, dataset: AbstractDataSet, mesh=None):

@@ -35,6 +35,22 @@ __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
            "process_allgather_pyobj"]
 
 
+_MAX_PYOBJ_PAYLOAD = 2 ** 31
+
+
+def _check_payload_size(n_bytes: int) -> None:
+    """The size gather rides jax arrays, which truncate int64 to int32
+    when x64 is off (the default) — a >= 2 GiB pickle would overflow
+    silently and corrupt the unpickle slicing. Refuse loudly instead
+    (ADVICE.md)."""
+    if n_bytes >= _MAX_PYOBJ_PAYLOAD:
+        raise ValueError(
+            f"process_allgather_pyobj payload of {n_bytes} bytes "
+            f"meets/exceeds the int32 size-gather limit "
+            f"({_MAX_PYOBJ_PAYLOAD - 1} bytes) — shard the object "
+            "across several gathers")
+
+
 def process_allgather_pyobj(obj):
     """Gather one arbitrary (picklable) python object per PROCESS; every
     process returns the list ordered by process index.
@@ -55,6 +71,7 @@ def process_allgather_pyobj(obj):
     from jax.experimental import multihost_utils
 
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    _check_payload_size(payload.size)
     sizes = multihost_utils.process_allgather(
         np.asarray([payload.size], np.int64))
     buf = np.zeros(int(sizes.max()), np.uint8)

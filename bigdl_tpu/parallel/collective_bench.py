@@ -171,7 +171,7 @@ def allreduce_bench(size_mb: float = 54.0, dtype="float32",
 
     logical = length * dtype.itemsize
     wire = logical * _WIRE_FACTOR["all-reduce"](n) if n > 1 else 0.0
-    return {
+    out = {
         "metric": "allreduce_bus_bandwidth",
         "devices": n,
         "payload_mb": round(logical / 1e6, 3),
@@ -181,6 +181,22 @@ def allreduce_bench(size_mb: float = 54.0, dtype="float32",
         "bus_gbps": round(wire / dt / 1e9, 3),
         "unit": "GB/s",
     }
+    # export through the process-wide registry so the microbenchmark
+    # lands on the same Prometheus/JSON surface as training metrics
+    from bigdl_tpu.observability.registry import default_registry
+    reg = default_registry()
+    lbl = {"dtype": str(dtype), "devices": str(n)}
+    names = ("dtype", "devices")
+    reg.gauge("collective_bench_alg_gbps",
+              "allreduce algorithmic bandwidth (logical bytes / time)",
+              labelnames=names).set(out["alg_gbps"], **lbl)
+    reg.gauge("collective_bench_bus_gbps",
+              "allreduce bus bandwidth (ring wire bytes / time)",
+              labelnames=names).set(out["bus_gbps"], **lbl)
+    reg.gauge("collective_bench_time_ms",
+              "allreduce mean iteration wall clock",
+              labelnames=names).set(out["time_ms"], **lbl)
+    return out
 
 
 def main(argv=None):

@@ -10,6 +10,9 @@ checker covering the rules that actually catch bugs in this codebase:
 - W191 tabs in indentation, W291 trailing whitespace
 - B006 mutable default arguments
 - E722 bare except
+- OBS1 module-level jax import inside bigdl_tpu/observability/ (the
+  subsystem is host-only by contract: importing jax there would couple
+  tracer/registry/summary to the device runtime)
 
 Run: ``python dev/lint.py`` (exit 1 on findings). Scans bigdl_tpu/,
 tests/, dev/, bench.py, __graft_entry__.py.
@@ -23,6 +26,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = ["bigdl_tpu", "tests", "dev", "bench.py", "__graft_entry__.py"]
 MAX_LEN = 79
+# packages that must stay importable without jax (host-only contract)
+HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",)
 
 
 def _files():
@@ -79,6 +84,26 @@ def _unused_imports(tree):
     return out
 
 
+def _toplevel_jax_imports(tree):
+    """Module-scope ``import jax`` / ``from jax... import`` findings.
+    Function-local imports stay legal — a lazily-imported helper can
+    touch jax at call time without coupling module import to the
+    device runtime."""
+    out = []
+    for node in tree.body:
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mods = [node.module or ""]
+        for m in mods:
+            if m == "jax" or m.startswith("jax."):
+                out.append((node.lineno,
+                            "OBS1 module-level jax import in host-only "
+                            "observability subsystem"))
+    return out
+
+
 def lint_file(path):
     rel = os.path.relpath(path, REPO)
     with open(path, encoding="utf-8") as f:
@@ -93,6 +118,9 @@ def lint_file(path):
     if os.path.basename(path) != "__init__.py":
         findings += [(rel, ln, msg)
                      for ln, msg in _unused_imports(tree)]
+    if rel.replace(os.sep, "/").startswith(HOST_ONLY_PREFIXES):
+        findings += [(rel, ln, msg)
+                     for ln, msg in _toplevel_jax_imports(tree)]
     for i, line in enumerate(src.splitlines(), 1):
         if "# noqa" in line:
             continue

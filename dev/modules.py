@@ -25,6 +25,7 @@ MODULES = {
     "models": ["tests/test_models.py", "tests/test_transformer.py",
                "tests/test_generate.py", "tests/test_rnn_generate.py",
                "tests/test_serving.py", "tests/test_perf_paths.py"],
+    "observability": ["tests/test_observability.py"],
     "harness": ["tests/test_bench_contract.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],
     "examples": ["tests/test_examples.py",
