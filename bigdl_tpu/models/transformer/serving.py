@@ -894,7 +894,8 @@ class ContinuousBatcher:
                 first, _ = paged_prefill(self.model, self.cache,
                                          row[None, :], padded,
                                          lengths=[len(prompt)])
-                tok0 = int(np.asarray(first)[0])
+                # deliberate sync: TTFT is DEFINED by this readback
+                tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
             # TTFT = queue wait + prefill, closed by the readback above
             self._m_ttft.observe(time.monotonic() - t_submit)
             self._m_admit.inc()

@@ -293,7 +293,9 @@ class DistriOptimizer(Optimizer):
                     compiled_steps[shape_key](
                         params, mstate, opt_state, step_rng, data,
                         labels, epoch_arr)
-                loss = float(loss)
+                # deliberate per-step readback: keeps the host loop in
+                # lockstep (the span above records this sync)
+                loss = float(loss)  # jaxlint: disable=JX1
             t2 = time.perf_counter()
             device_time = t2 - t1
             step_time = t2 - t0

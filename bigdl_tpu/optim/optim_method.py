@@ -204,12 +204,16 @@ class LBFGS(OptimMethod):
                 q = q + s * (a - b)
             d = q
 
-            gtd = jnp.dot(g, d)
-            if float(gtd) > -self.tolerance_x:
+            # the host loop needs two scalars before it can step
+            # (descent check + first-iteration scale); read them in ONE
+            # packed transfer instead of two blocking float() calls
+            gtd_h, gsum_h = (
+                float(v) for v in jax.device_get(
+                    jnp.stack([jnp.dot(g, d), jnp.sum(jnp.abs(g))])))
+            if gtd_h > -self.tolerance_x:
                 break
             t = self.learning_rate if it > 0 else \
-                min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) \
-                * self.learning_rate
+                min(1.0, 1.0 / gsum_h) * self.learning_rate
 
             if self.line_search:
                 t, fx, g, n_ls = self._lswolfe(f, xk, fx, g, d, t)
@@ -220,12 +224,19 @@ class LBFGS(OptimMethod):
                 fx_new, g_new = f(xk)
                 n_eval += 1
                 fx, g = fx_new, g_new
-            losses.append(float(fx))
 
             s = xk - x_prev
             y = g - g_prev
             ys = jnp.dot(y, s)
-            if float(ys) > 1e-10:
+            # post-step scalars (loss, curvature, grad inf-norm) ride
+            # one packed transfer too: 2 device→host syncs per
+            # iteration total, down from 5 scattered float() reads
+            fx_h, ys_h, ginf_h = (
+                float(v) for v in jax.device_get(
+                    jnp.stack([jnp.asarray(fx), ys,
+                               jnp.max(jnp.abs(g))])))
+            losses.append(fx_h)
+            if ys_h > 1e-10:
                 if len(s_list) == self.n_correction:
                     s_list.pop(0)
                     y_list.pop(0)
@@ -238,7 +249,7 @@ class LBFGS(OptimMethod):
 
             if n_eval >= self.max_eval:
                 break
-            if float(jnp.max(jnp.abs(g))) <= self.tolerance_fun:
+            if ginf_h <= self.tolerance_fun:
                 break
             if len(losses) > 1 and abs(losses[-1] - losses[-2]) \
                     < self.tolerance_fun:
@@ -248,19 +259,27 @@ class LBFGS(OptimMethod):
 
     @staticmethod
     def _lswolfe(f, x, fx, g, d, t, c1=1e-4, c2=0.9, max_ls=25):
-        """Backtracking Wolfe line search (reference LineSearch.lswolfe)."""
-        gtd = jnp.dot(g, d)
-        fx0, gtd0 = fx, gtd
+        """Backtracking Wolfe line search (reference LineSearch.lswolfe).
+
+        Each probe reads exactly ONE packed (loss, directional-grad)
+        scalar pair from the device — the search is host-driven, so
+        per-probe syncs are unavoidable, but they need not be three."""
+        fx0_h, gtd0_h = (
+            float(v) for v in jax.device_get(
+                jnp.stack([jnp.asarray(fx), jnp.dot(g, d)])))
         n_eval = 0
         lo, hi = 0.0, None
         for _ in range(max_ls):
             fx_t, g_t = f(x + t * d)
             n_eval += 1
-            if float(fx_t) > float(fx0 + c1 * t * gtd0):
+            fx_h, gtd_h = (
+                float(v) for v in jax.device_get(
+                    jnp.stack([jnp.asarray(fx_t), jnp.dot(g_t, d)])))
+            if fx_h > fx0_h + c1 * t * gtd0_h:
                 hi = t
-            elif abs(float(jnp.dot(g_t, d))) <= -c2 * float(gtd0):
+            elif abs(gtd_h) <= -c2 * gtd0_h:
                 return t, fx_t, g_t, n_eval
-            elif float(jnp.dot(g_t, d)) < 0:
+            elif gtd_h < 0:
                 lo = t
             else:
                 hi = t

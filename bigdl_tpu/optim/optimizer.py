@@ -485,7 +485,9 @@ class LocalOptimizer(Optimizer):
                 params, mstate, opt_state, loss = jit_step(
                     params, mstate, opt_state, step_rng, data, labels,
                     jnp.asarray(driver_state["epoch"], jnp.int32))
-                loss = float(loss)  # blocks; keeps host loop in lockstep
+                # blocks; keeps host loop in lockstep (the span above
+                # records this sync)
+                loss = float(loss)  # jaxlint: disable=JX1
             t2 = time.perf_counter()
             device_time = t2 - t1
             step_time = t2 - t0

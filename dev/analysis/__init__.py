@@ -1,0 +1,10 @@
+"""jaxlint — static analysis for TPU-correctness footguns.
+
+``dev/lint.py`` is the entry point; it delegates the JX rules here.
+See docs/STATIC_ANALYSIS.md for the rule catalogue and workflow.
+"""
+from .jaxlint import (            # noqa: F401
+    BASELINE_PATH, Finding, HOST_ONLY_PREFIXES, LOOP_SYNC_PREFIXES,
+    RULES, analyze_file, analyze_source, apply_baseline,
+    format_baseline_entry, load_baseline, run,
+)

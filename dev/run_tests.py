@@ -37,7 +37,16 @@ def main(argv=None):
             print(f"{name}: {' '.join(files)}")
         return 0
 
-    names = (args.modules.split(",") if args.modules else list(MODULES))
+    if args.modules is not None:
+        # a typo'd or empty --modules must error with the known-module
+        # list, never silently select nothing
+        names = [n.strip() for n in args.modules.split(",") if n.strip()]
+        if not names:
+            print(f"--modules selected nothing from {args.modules!r}; "
+                  f"known modules: {sorted(MODULES)}")
+            return 2
+    else:
+        names = list(MODULES)
     unknown = [n for n in names if n not in MODULES]
     if unknown:
         print(f"unknown modules: {unknown}; known: {sorted(MODULES)}")

@@ -1,0 +1,481 @@
+"""jaxlint rule fixtures: every JX rule fires on a minimal snippet,
+``# jaxlint: disable=`` silences it, and the baseline honors/prunes
+entries. The analyzer itself never imports jax — these tests run the
+AST passes only."""
+import os
+import sys
+import textwrap
+
+_DEV = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "dev")
+if _DEV not in sys.path:
+    sys.path.insert(0, _DEV)
+
+from analysis import jaxlint  # noqa: E402
+
+LIB = "bigdl_tpu/fixture.py"      # loop-sync rules apply here
+HOST = "tests/fixture.py"         # ...but not here
+
+
+def lint(src, rel=LIB, **cfg):
+    return jaxlint.analyze_source(textwrap.dedent(src), rel, **cfg)
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+class TestJX1HostSync:
+    def test_fires_inside_decorated_jit(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x) * 2
+        """)
+        assert rules(out) == ["JX1"]
+        assert "jit-compiled" in out[0].msg
+
+    def test_fires_inside_function_passed_to_jit(self):
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def step(x):
+                return x.item()
+
+            jit_step = jax.jit(step)
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_fires_through_jit_reachable_helper(self):
+        out = lint("""
+            import jax
+
+            def helper(x):
+                return int(x)
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_fires_inside_grad_traced_function(self):
+        out = lint("""
+            import jax
+
+            def loss(x):
+                return bool(x)
+
+            g = jax.grad(loss)
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_fires_per_iteration_loop_sync_in_library_code(self):
+        out = lint("""
+            import jax.numpy as jnp
+
+            def fit(xs):
+                tot = 0.0
+                for x in xs:
+                    tot += float(jnp.sum(x))
+                return tot
+        """)
+        assert rules(out) == ["JX1"]
+        assert "per-iteration" in out[0].msg
+
+    def test_loop_sync_not_applied_to_test_code(self):
+        out = lint("""
+            import jax.numpy as jnp
+
+            def fit(xs):
+                tot = 0.0
+                for x in xs:
+                    tot += float(jnp.sum(x))
+                return tot
+        """, rel=HOST)
+        assert out == []
+
+    def test_device_get_is_the_sanctioned_readback(self):
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def fit(xs):
+                tot = 0.0
+                for x in xs:
+                    a, b = jax.device_get(
+                        jnp.stack([jnp.sum(x), jnp.max(x)]))
+                    tot += float(a) + float(b)
+                return tot
+        """)
+        assert out == []
+
+    def test_shape_reads_and_numpy_values_are_not_syncs(self):
+        out = lint("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            @__import__('jax').jit
+            def noop(x):
+                return x
+
+            def fit(xs):
+                for x in xs:
+                    n = int(x.shape[0])
+                    v = float(np.prod([1, 2]))
+                    y = jnp.zeros((n,))
+                    m = np.asarray(y)        # jaxlint: disable=JX1
+                    k = int(m[0])            # host value now
+                return 0
+        """)
+        assert out == []
+
+    def test_np_asarray_on_traced_value_fires(self):
+        out = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.asarray(x)
+        """)
+        assert rules(out) == ["JX1"]
+
+
+class TestJX2KeyReuse:
+    def test_fires_on_straight_line_reuse(self):
+        out = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.normal(key, (2,))
+                return a + b
+        """)
+        assert rules(out) == ["JX2"]
+        assert "'key'" in out[0].msg
+
+    def test_split_rebind_is_clean(self):
+        out = lint("""
+            import jax
+
+            def sample(key):
+                key, sub = jax.random.split(key)
+                a = jax.random.normal(sub, (2,))
+                key, sub = jax.random.split(key)
+                b = jax.random.normal(sub, (2,))
+                return a + b
+        """)
+        assert out == []
+
+    def test_fires_on_loop_reuse_without_split(self):
+        out = lint("""
+            import jax
+
+            def sample(key, n):
+                outs = []
+                for _ in range(n):
+                    outs.append(jax.random.normal(key, (2,)))
+                return outs
+        """)
+        assert rules(out) == ["JX2"]
+
+    def test_fold_in_per_iteration_is_the_sanctioned_idiom(self):
+        out = lint("""
+            import jax
+
+            def sample(key, n):
+                outs = []
+                for i in range(n):
+                    k = jax.random.fold_in(key, i)
+                    outs.append(jax.random.normal(k, (2,)))
+                return outs
+        """)
+        assert out == []
+
+    def test_split_then_reusing_parent_key_fires(self):
+        out = lint("""
+            import jax
+
+            def sample(key):
+                sub, _ = jax.random.split(key)
+                return jax.random.normal(key, (2,))
+        """)
+        assert rules(out) == ["JX2"]
+
+
+class TestJX3UseAfterDonation:
+    def test_fires_on_read_after_donating_call(self):
+        out = lint("""
+            import jax
+
+            def train(step, params, batch):
+                jit_step = jax.jit(step, donate_argnums=(0,))
+                new_params = jit_step(params, batch)
+                return params, new_params
+        """)
+        assert rules(out) == ["JX3"]
+        assert "'params'" in out[0].msg
+
+    def test_rebinding_from_the_call_is_clean(self):
+        out = lint("""
+            import jax
+
+            def train(step, params, batches):
+                jit_step = jax.jit(step, donate_argnums=(0,))
+                for b in batches:
+                    params = jit_step(params, b)
+                return params
+        """)
+        assert out == []
+
+    def test_fires_across_loop_iterations_without_rebind(self):
+        out = lint("""
+            import jax
+
+            def train(step, params, batches):
+                jit_step = jax.jit(step, donate_argnums=(0,))
+                outs = []
+                for b in batches:
+                    outs.append(jit_step(params, b))
+                return outs
+        """)
+        assert rules(out) == ["JX3"]
+
+    def test_tracks_dotted_paths_and_partial_decorators(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill(params, pool):
+                return pool
+
+            def serve(model, cache):
+                new_pool = prefill(model.params, cache.kp)
+                stale = cache.kp[0]
+                cache.kp = new_pool
+                return stale
+        """)
+        assert rules(out) == ["JX3"]
+        assert "'cache.kp'" in out[0].msg
+
+    def test_dotted_rebind_is_clean(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill(params, pool):
+                return pool
+
+            def serve(model, cache):
+                cache.kp = prefill(model.params, cache.kp)
+                return cache.kp
+        """)
+        assert out == []
+
+
+class TestJX4AxisNames:
+    def test_fires_on_unbound_literal_axis(self):
+        out = lint("""
+            import jax
+            from jax.sharding import Mesh
+
+            def reduce(x, devs):
+                mesh = Mesh(devs, ("data", "model"))
+                return jax.lax.psum(x, "batch")
+        """)
+        assert rules(out) == ["JX4"]
+        assert "'batch'" in out[0].msg
+
+    def test_bound_axis_is_clean(self):
+        out = lint("""
+            import jax
+            from jax.sharding import Mesh
+
+            def reduce(x, devs):
+                mesh = Mesh(devs, ("data", "model"))
+                return jax.lax.psum(x, "data")
+        """)
+        assert out == []
+
+    def test_partition_spec_and_pmap_bind_axes(self):
+        out = lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def reduce(f, x):
+                spec = P("rows")
+                g = jax.pmap(f, axis_name="cols")
+                a = jax.lax.pmean(x, "rows")
+                b = jax.lax.all_gather(x, "cols")
+                return a, b, g, spec
+        """)
+        assert out == []
+
+    def test_silent_when_file_binds_no_axes(self):
+        out = lint("""
+            import jax
+
+            def reduce(x, axis):
+                return jax.lax.psum(x, "data")
+        """)
+        assert out == []
+
+    def test_variable_axis_names_are_not_checked(self):
+        out = lint("""
+            import jax
+            from jax.sharding import Mesh
+
+            def reduce(x, devs, axis):
+                mesh = Mesh(devs, ("data",))
+                return jax.lax.psum(x, axis)
+        """)
+        assert out == []
+
+
+class TestJX5HostOnlyImports:
+    SRC = """
+        import jax
+
+        def trace_to_device(x):
+            return x
+    """
+
+    def test_fires_under_host_only_prefix(self):
+        out = lint(self.SRC, rel="bigdl_tpu/observability/tracing.py")
+        assert rules(out) == ["JX5"]
+
+    def test_silent_elsewhere(self):
+        assert lint(self.SRC, rel="bigdl_tpu/nn/linear.py") == []
+
+    def test_prefix_list_is_configurable(self):
+        out = lint(self.SRC, rel="bigdl_tpu/nn/linear.py",
+                   host_only_prefixes=("bigdl_tpu/nn/",))
+        assert rules(out) == ["JX5"]
+
+    def test_lazy_function_local_import_is_clean(self):
+        out = lint("""
+            def trace_to_device(x):
+                import jax
+                return jax.device_put(x)
+        """, rel="bigdl_tpu/observability/tracing.py")
+        assert out == []
+
+
+class TestSuppressions:
+    def test_disable_silences_named_rule(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)  # jaxlint: disable=JX1
+        """)
+        assert out == []
+
+    def test_bare_disable_silences_everything(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)  # jaxlint: disable
+        """)
+        assert out == []
+
+    def test_wrong_rule_id_does_not_silence(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)  # jaxlint: disable=JX2
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_disable_takes_a_comma_list(self):
+        out = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.normal(key, (2,))  # jaxlint: disable=JX2,JX1
+                return a + b
+        """)
+        assert out == []
+
+
+class TestBaseline:
+    SRC = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)
+    """
+
+    def finding(self):
+        (f,) = lint(self.SRC)
+        return f
+
+    def test_entry_covers_matching_finding(self):
+        f = self.finding()
+        entry = (f.path, f.rule, f.source)
+        new, stale = jaxlint.apply_baseline([f], [entry])
+        assert new == [] and stale == []
+
+    def test_fingerprint_survives_line_churn(self):
+        f = self.finding()
+        entry = (f.path, f.rule, f.source)
+        shifted = lint("\n\n\n" + textwrap.dedent(self.SRC))
+        new, stale = jaxlint.apply_baseline(shifted, [entry])
+        assert new == [] and stale == []
+
+    def test_stale_entries_are_reported(self):
+        f = self.finding()
+        gone = (f.path, f.rule, "return int(x)")
+        new, stale = jaxlint.apply_baseline([f], [gone])
+        assert new == [f] and stale == [gone]
+
+    def test_roundtrip_through_file(self, tmp_path):
+        f = self.finding()
+        p = tmp_path / "baseline.txt"
+        p.write_text("# comment\n\n"
+                     + jaxlint.format_baseline_entry(f) + "\n")
+        entries = jaxlint.load_baseline(str(p))
+        assert entries == [(f.path, f.rule, f.source)]
+        new, stale = jaxlint.apply_baseline([f], entries)
+        assert new == [] and stale == []
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        assert jaxlint.load_baseline(str(tmp_path / "nope.txt")) == []
+
+
+class TestRunTestsRegistry:
+    def _main(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "dev_run_tests", os.path.join(_DEV, "run_tests.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main
+
+    def test_unknown_module_errors_with_known_list(self, capsys):
+        assert self._main()(["--modules", "optm"]) == 2
+        msg = capsys.readouterr().out
+        assert "unknown modules" in msg and "optim" in msg
+
+    def test_empty_selection_errors(self, capsys):
+        assert self._main()(["--modules", " , "]) == 2
+        assert "known modules" in capsys.readouterr().out
+
+    def test_names_are_stripped_before_lookup(self, capsys):
+        assert self._main()(["--modules", " optm , "]) == 2
+        assert "['optm']" in capsys.readouterr().out

@@ -1,0 +1,69 @@
+"""Repo self-check: ``dev/lint.py`` (classic rules + every jaxlint JX
+rule) runs clean over the whole tree against the committed baseline.
+
+This is the gate that keeps TPU footguns (hidden host syncs, PRNG key
+reuse, use-after-donation, axis-name drift, host-only-package jax
+imports) from re-entering the codebase: a new finding either gets
+fixed, suppressed inline with a reason, or consciously added to
+``dev/analysis/baseline.txt`` in review."""
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEV = os.path.join(_REPO, "dev")
+if _DEV not in sys.path:
+    sys.path.insert(0, _DEV)
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "dev_lint", os.path.join(_REPO, "dev", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_lints_clean(capsys):
+    lint = _load_lint()
+    rc = lint.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, f"dev/lint.py found problems:\n{out}"
+    assert "0 finding(s)" in out
+
+
+def test_lint_scans_scripts_and_runs_jx_rules():
+    lint = _load_lint()
+    scanned = {os.path.relpath(p, _REPO).split(os.sep)[0]
+               for p in lint._files()}
+    assert "scripts" in lint.TARGETS
+    assert {"bigdl_tpu", "tests", "dev"} <= scanned
+    # the jaxlint delegation is live (rules registered, baseline wired)
+    findings, all_jx = lint.run_jaxlint(
+        [os.path.join(_REPO, "dev", "analysis", "jaxlint.py")])
+    assert findings == []
+
+
+def test_baseline_has_no_stale_entries():
+    """Every baseline entry must still match a real finding — prune
+    entries when their finding is fixed (lint.py reports them as JLB
+    findings, this pins the contract)."""
+    lint = _load_lint()
+    from analysis import jaxlint
+    entries = jaxlint.load_baseline()
+    findings = []
+    for p in lint._files():
+        findings.extend(jaxlint.analyze_file(p, _REPO))
+    _, stale = jaxlint.apply_baseline(findings, entries)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_fixed_lbfgs_reads_stay_fixed():
+    """The L-BFGS per-iteration host reads were batched into packed
+    jax.device_get transfers (the analyzer's first real catch); a
+    scattered float() re-introduction must fail the self-check, not
+    just a perf run."""
+    from analysis import jaxlint
+    path = os.path.join(_REPO, "bigdl_tpu", "optim", "optim_method.py")
+    findings = jaxlint.analyze_file(path, _REPO)
+    assert [f for f in findings if f.rule == "JX1"] == []

@@ -14,7 +14,6 @@ The load-bearing invariants:
   changes neither the compile count nor the one-dispatch-per-step
   burst loop, and never adds a device sync.
 """
-import ast
 import glob
 import importlib.util
 import json
@@ -580,34 +579,42 @@ def _load_lint():
 
 
 class TestLintHostOnlyRule:
+    """OBS1 became jaxlint rule JX5 (dev/analysis/,
+    docs/STATIC_ANALYSIS.md) — same contract, configurable prefixes."""
+
+    def _jaxlint(self):
+        _load_lint()            # puts dev/ on sys.path
+        from analysis import jaxlint
+        return jaxlint
+
     def test_detects_toplevel_jax_imports(self):
-        lint = _load_lint()
+        jaxlint = self._jaxlint()
         bad = ("import jax\n"
                "from jax import numpy\n"
                "from jax.sharding import Mesh\n"
                "import numpy\n"
                "def f():\n"
                "    import jax\n")
-        found = lint._toplevel_jax_imports(ast.parse(bad))
-        assert [ln for ln, _ in found] == [1, 2, 3]
-        assert all("OBS1" in msg for _, msg in found)
+        found = jaxlint.analyze_source(
+            bad, "bigdl_tpu/observability/bad.py")
+        assert [f.line for f in found] == [1, 2, 3]
+        assert all(f.rule == "JX5" for f in found)
 
     def test_observability_package_is_clean(self):
-        lint = _load_lint()
+        jaxlint = self._jaxlint()
         files = glob.glob(os.path.join(
             REPO, "bigdl_tpu", "observability", "*.py"))
         assert files, "observability package missing?"
         for path in files:
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read())
-            assert lint._toplevel_jax_imports(tree) == [], path
+            found = jaxlint.analyze_file(path, REPO)
+            assert [f for f in found if f.rule == "JX5"] == [], path
 
     def test_lint_file_applies_rule_to_package(self):
         lint = _load_lint()
         path = os.path.join(REPO, "bigdl_tpu", "observability",
                             "registry.py")
-        assert all("OBS1" not in msg
-                   for _, _, msg in lint.lint_file(path))
+        findings, _ = lint.run_jaxlint([path])
+        assert all("JX5" not in msg for _, _, msg in findings)
 
 
 # ---------------------------------------------------------------------------
