@@ -11,18 +11,18 @@ THE NO-SYNC CONTRACT. Spans read ``time.monotonic()`` and append to a
 host list — nothing else. They must wrap code OUTSIDE jitted functions
 (dispatch, host input, readback); they never call ``block_until_ready``
 and never make a span boundary force one. Where the surrounding loop
-*intentionally* blocks on a device value (``float(loss)``,
-``np.asarray(tokens)``), pass ``host_sync="why"`` to :meth:`span` or
-call :meth:`host_sync` so the sync is EXPLICIT in the trace instead of
-an invisible stall. dev/lint.py enforces that this package never
-imports jax at module top level.
+*intentionally* blocks on a device value (the optimizers' packed loss
+drain, ``np.asarray(tokens)``), pass ``host_sync="why"`` to
+:meth:`span` or call :meth:`host_sync` so the sync is EXPLICIT in the
+trace instead of an invisible stall. dev/lint.py enforces that this
+package never imports jax at module top level.
 
 A process-wide tracer (disabled by default — disabled spans are a
 single attribute check) sits behind module-level ``span`` / ``instant``
 / ``counter`` / ``enable`` / ``export`` so call sites just do::
 
     from bigdl_tpu.observability import trace
-    with trace.span("device step", host_sync="loss readback"):
+    with trace.span("loss drain", host_sync="packed loss readback"):
         ...
 """
 from __future__ import annotations

@@ -64,6 +64,9 @@ class DistriOptimizer(Optimizer):
         # sequence_parallel=...). Composes with data and tensor
         # parallelism: one jitted step over a dp x tp x sp mesh.
         self.sequence_parallel = sequence_parallel
+        # cached after _account_collectives — the hot loop must not
+        # re-read the metrics dict every iteration
+        self._wire_bytes = 0.0
 
     def _account_collectives(self, compiled, n_devices: int) -> None:
         """Static per-step collective-bytes accounting from the compiled
@@ -81,6 +84,7 @@ class DistriOptimizer(Optimizer):
                          acct["logical_bytes"])
         self.metrics.set("collective wire bytes per chip per step",
                          acct["wire_bytes_per_chip"])
+        self._wire_bytes = float(acct["wire_bytes_per_chip"])
         logger.info(
             "collectives per step: %d ops, %.1f MB logical, %.1f MB wire "
             "per chip (ring estimate)", acct["ops"],
@@ -109,6 +113,22 @@ class DistriOptimizer(Optimizer):
             return data, labels
         return (jax.device_put(data, sharding),
                 jax.device_put(labels, label_sharding))
+
+    def _emit_step(self, e: dict, loss: float) -> None:
+        super()._emit_step(e, loss)
+        if self._wire_bytes > 0 and not e["compiled"]:
+            # device step time >= collective time, so this is a LOWER
+            # bound on link bandwidth — the honest in-training readout
+            # (the isolated figure comes from parallel/collective_bench);
+            # compile iterations are excluded, their wall time is
+            # compilation, not the link. Under async dispatch the device
+            # time is window-amortized (docs/PERFORMANCE.md), so this
+            # stays a per-window average rather than a per-step sample.
+            self.metrics.record(
+                "allreduce GB/s (wire bytes / device step, lower bound)",
+                self._wire_bytes / max(e["device_time"], 1e-9) / 1e9)
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug(self.metrics.summary())
 
     def optimize(self):
         model, criterion, optim = self.model, self.criterion, \
@@ -235,6 +255,8 @@ class DistriOptimizer(Optimizer):
         batches_this_epoch = batches_to_skip
         for _ in range(batches_to_skip):   # fast-forward to the stop point
             next(data_iter)
+        window, lockstep = self._dispatch_window()
+        pending: list[dict] = []
         wallclock_start = time.perf_counter()
 
         while self.end_when is None or not self.end_when(driver_state):
@@ -288,48 +310,37 @@ class DistriOptimizer(Optimizer):
                 if not compiled_steps:
                     self._account_collectives(compiled, n_shards)
                 compiled_steps[shape_key] = compiled
-            with trace.span("device step", host_sync="loss readback"):
+            with trace.span("device step"):
+                # dispatch only — loss stays on device; the packed
+                # readback happens at drain time (docs/PERFORMANCE.md).
+                # Honest phase metrics: the reference's get-weights/
+                # compute/aggregate phases fuse inside the jitted step,
+                # so what's measurable is host input vs device step
+                # (see metrics.py)
                 params, mstate, opt_state, loss = \
                     compiled_steps[shape_key](
                         params, mstate, opt_state, step_rng, data,
                         labels, epoch_arr)
-                # deliberate per-step readback: keeps the host loop in
-                # lockstep (the span above records this sync)
-                loss = float(loss)  # jaxlint: disable=JX1
             t2 = time.perf_counter()
-            device_time = t2 - t1
-            step_time = t2 - t0
             n = global_n  # records consumed across all hosts this step
             count_this_epoch += n
             batches_this_epoch += 1
-            driver_state["loss"] = loss
-            wallclock = time.perf_counter() - wallclock_start
-            logger.info(
-                self._header(driver_state["epoch"], count_this_epoch,
-                             epoch_size, driver_state["neval"], wallclock)
-                + f" loss is {loss:.6f}, iteration time is {step_time:.4f}s,"
-                f" host input time is {data_time:.4f}s, device step time is "
-                f"{device_time:.4f}s, throughput is "
-                f"{n / max(step_time, 1e-9):.2f} records/second")
-            # honest phase metrics: the reference's get-weights/compute/
-            # aggregate phases fuse inside the jitted step, so what's
-            # measurable is host input vs device step (see metrics.py)
-            self._record_step(driver_state["neval"], loss, n, step_time,
-                              data_time, device_time)
-            wire = self.metrics.get("collective wire bytes per chip per step")
-            if wire > 0 and not compiled_this_iter:
-                # device step time >= collective time, so this is a LOWER
-                # bound on link bandwidth — the honest in-training readout
-                # (the isolated figure comes from parallel/collective_bench);
-                # compile iterations are excluded, their wall time is
-                # compilation, not the link
-                self.metrics.record(
-                    "allreduce GB/s (wire bytes / device step, lower bound)",
-                    wire / device_time / 1e9)
-            if logger.isEnabledFor(logging.DEBUG):
-                logger.debug(self.metrics.summary())
+            pending.append({"epoch": driver_state["epoch"],
+                            "count": count_this_epoch,
+                            "epoch_size": epoch_size,
+                            "neval": driver_state["neval"],
+                            "wallclock": time.perf_counter()
+                            - wallclock_start,
+                            "loss": loss, "n": n,
+                            "step_time": t2 - t0, "data_time": data_time,
+                            "device_time": t2 - t1,
+                            "compiled": compiled_this_iter})
+            if len(pending) >= window:
+                self._drain_pending(pending, driver_state,
+                                    lockstep or "window full")
             driver_state["neval"] += 1
             if count_this_epoch >= epoch_size:
+                self._drain_pending(pending, driver_state, "epoch end")
                 driver_state["epoch"] += 1
                 driver_state["is_epoch_end"] = True
                 count_this_epoch = 0
@@ -339,8 +350,11 @@ class DistriOptimizer(Optimizer):
                 data_iter = self.dataset.data(train=True)
             fire_val, fire_ckpt = self._fires(driver_state)
             if fire_val or fire_ckpt:
-                # publish params only when validation/checkpoint will read
-                # them (host-side tree walk is overhead on deep models)
+                # validation/checkpoint read host-visible state: flush
+                # the window first, then publish params (host-side tree
+                # walk is overhead on deep models)
+                self._drain_pending(pending, driver_state,
+                                    "validation/checkpoint trigger")
                 model.sync(params, mstate)
             self._validate(eval_fn, params, mstate, driver_state,
                            fire=fire_val)
@@ -348,6 +362,7 @@ class DistriOptimizer(Optimizer):
                              count_this_epoch, batches_this_epoch,
                              epoch_start_host_rng, fire=fire_ckpt)
 
+        self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()
         model.sync(params, mstate)
         model.evaluate()

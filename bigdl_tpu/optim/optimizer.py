@@ -129,6 +129,9 @@ class Optimizer:
         self.input_transform = None
         self.train_summary = None
         self.val_summary = None
+        # async dispatch: how many steps may be in flight before the loop
+        # drains their losses with one packed readback (docs/PERFORMANCE.md)
+        self.max_in_flight = 2
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -179,9 +182,11 @@ class Optimizer:
     def set_train_summary(self, summary):
         """Per-iteration scalar event log (reference-parity
         ``TrainSummary``, observability/summary.py): the loop appends
-        Loss / Throughput / HostInputTime / DeviceStepTime at every
-        step. Host floats only — recording never adds a device sync the
-        loop wasn't already paying. Returns self."""
+        Loss / Throughput / HostInputTime / DeviceStepTime for every
+        step, emitted at window-drain time under the step's original
+        ``neval`` (docs/PERFORMANCE.md). Host floats only — recording
+        never adds a device sync the loop wasn't already paying.
+        Returns self."""
         self.train_summary = summary
         return self
 
@@ -202,6 +207,22 @@ class Optimizer:
         self.input_transform = fn
         return self
 
+    def set_async_dispatch(self, max_in_flight: int = 2):
+        """Bound how far the train loop's dispatch pipeline may run ahead
+        of the host before draining the pending losses with ONE packed
+        ``jax.device_get``. ``max_in_flight=1`` is the classic lockstep
+        loop (a readback every iteration); larger windows let XLA's async
+        dispatch overlap host-input work with device steps at the cost of
+        the logged loss lagging ``neval`` by up to the window
+        (docs/PERFORMANCE.md). Triggers whose ``requires`` includes
+        ``"loss"`` (``min_loss``) force lockstep regardless. Returns
+        self."""
+        if int(max_in_flight) < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {max_in_flight}")
+        self.max_in_flight = int(max_in_flight)
+        return self
+
     def set_end_when(self, end_when: Trigger):
         self.end_when = end_when
         return self
@@ -220,8 +241,9 @@ class Optimizer:
                      device_time: float) -> None:
         """Shared per-iteration observability: the honest host-side
         phase split into Metrics (-> registry histograms) plus the
-        TrainSummary event log. Called AFTER the step's own
-        ``float(loss)`` sync — everything here is host arithmetic."""
+        TrainSummary event log. Called at DRAIN time with the step's
+        original ``neval`` stamp — ``loss`` is already a host float;
+        everything here is host arithmetic."""
         self.metrics.record("device step time", device_time)
         self.metrics.record("host input time", data_time)
         if self.train_summary is not None:
@@ -385,6 +407,77 @@ class Optimizer:
                      and self.checkpoint_trigger(driver_state))
         return fire_val, fire_ckpt
 
+    # -- async dispatch (docs/PERFORMANCE.md) --
+    def _loss_sync_reason(self) -> str | None:
+        """Which configured trigger (if any) reads the loss and therefore
+        forces a readback every iteration — the stopping decision must
+        see the true per-step value, so the dispatch window collapses to
+        lockstep."""
+        for what, t in (("end_when", self.end_when),
+                        ("validation trigger", self.validation_trigger),
+                        ("checkpoint trigger", self.checkpoint_trigger)):
+            if t is not None and "loss" in getattr(t, "requires",
+                                                   frozenset()):
+                return f"{what} {t!r} reads loss"
+        return None
+
+    def _dispatch_window(self) -> tuple[int, str | None]:
+        """Effective in-flight window for this run: ``max_in_flight``
+        unless a loss-reading trigger forces lockstep."""
+        reason = self._loss_sync_reason()
+        if reason is not None:
+            if self.max_in_flight > 1:
+                logger.info(
+                    "async dispatch disabled (%s) — draining loss every "
+                    "iteration to preserve exact stopping semantics",
+                    reason)
+            return 1, reason
+        return self.max_in_flight, None
+
+    def _emit_step(self, e: dict, loss: float) -> None:
+        """Emit one drained step's log line + observability records,
+        stamped with the step's ORIGINAL counters (the drain may run up
+        to ``max_in_flight`` iterations later). The f-string is only
+        built when INFO is live — this runs once per iteration."""
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                self._header(e["epoch"], e["count"], e["epoch_size"],
+                             e["neval"], e["wallclock"])
+                + f" loss is {loss:.6f}, iteration time is "
+                f"{e['step_time']:.4f}s, host input time is "
+                f"{e['data_time']:.4f}s, device step time is "
+                f"{e['device_time']:.4f}s, throughput is "
+                f"{e['n'] / max(e['step_time'], 1e-9):.2f} records/second")
+        self._record_step(e["neval"], loss, e["n"], e["step_time"],
+                          e["data_time"], e["device_time"])
+
+    def _drain_pending(self, pending: list, driver_state: dict,
+                       reason: str) -> None:
+        """Drain the in-flight window: ONE packed ``jax.device_get`` for
+        every pending loss (the sanctioned batched readback — the only
+        host<-device sync in the steady-state loop), then emit each
+        step's deferred log line / summary scalars under its original
+        ``neval``. The readback wait cannot be attributed to a single
+        step once dispatch runs ahead, so it is amortized evenly across
+        the window (window-amortized device time, docs/PERFORMANCE.md).
+        """
+        if not pending:
+            return
+        depth = len(pending)
+        self.metrics.set("dispatch depth", depth)
+        t0 = time.perf_counter()
+        with trace.span("loss drain", host_sync="packed loss readback",
+                        depth=depth, reason=reason):
+            losses = jax.device_get([e["loss"] for e in pending])
+        share = (time.perf_counter() - t0) / depth
+        for e, lv in zip(pending, losses):
+            loss = float(lv)
+            e["device_time"] += share
+            e["step_time"] += share
+            self._emit_step(e, loss)
+            driver_state["loss"] = loss
+        pending.clear()
+
     def _resume(self, optim, params):
         """Rebuild (opt_state, rng, count_this_epoch, batches_to_skip) from
         ``self.state`` — full-fidelity when the state came from a round-2
@@ -469,6 +562,8 @@ class LocalOptimizer(Optimizer):
         batches_this_epoch = batches_to_skip
         for _ in range(batches_to_skip):   # fast-forward to the stop point
             next(data_iter)
+        window, lockstep = self._dispatch_window()
+        pending: list[dict] = []
         wallclock_start = time.perf_counter()
 
         while self.end_when is None or not self.end_when(driver_state):
@@ -481,32 +576,31 @@ class LocalOptimizer(Optimizer):
             t1 = time.perf_counter()
             data_time = t1 - t0
             rng, step_rng = jax.random.split(rng)
-            with trace.span("device step", host_sync="loss readback"):
+            with trace.span("device step"):
+                # dispatch only — loss stays on device; the packed
+                # readback happens at drain time (docs/PERFORMANCE.md)
                 params, mstate, opt_state, loss = jit_step(
                     params, mstate, opt_state, step_rng, data, labels,
                     jnp.asarray(driver_state["epoch"], jnp.int32))
-                # blocks; keeps host loop in lockstep (the span above
-                # records this sync)
-                loss = float(loss)  # jaxlint: disable=JX1
             t2 = time.perf_counter()
-            device_time = t2 - t1
-            step_time = t2 - t0
             n = int(data.shape[0])
             count_this_epoch += n
             batches_this_epoch += 1
-            driver_state["loss"] = loss
-            wallclock = time.perf_counter() - wallclock_start
-            logger.info(
-                self._header(driver_state["epoch"], count_this_epoch,
-                             epoch_size, driver_state["neval"], wallclock)
-                + f" loss is {loss:.6f}, iteration time is {step_time:.4f}s,"
-                f" host input time is {data_time:.4f}s, device step time is "
-                f"{device_time:.4f}s, "
-                f"throughput is {n / max(step_time, 1e-9):.2f} records/second")
-            self._record_step(driver_state["neval"], loss, n, step_time,
-                              data_time, device_time)
+            pending.append({"epoch": driver_state["epoch"],
+                            "count": count_this_epoch,
+                            "epoch_size": epoch_size,
+                            "neval": driver_state["neval"],
+                            "wallclock": time.perf_counter()
+                            - wallclock_start,
+                            "loss": loss, "n": n,
+                            "step_time": t2 - t0, "data_time": data_time,
+                            "device_time": t2 - t1})
+            if len(pending) >= window:
+                self._drain_pending(pending, driver_state,
+                                    lockstep or "window full")
             driver_state["neval"] += 1
             if count_this_epoch >= epoch_size:
+                self._drain_pending(pending, driver_state, "epoch end")
                 driver_state["epoch"] += 1
                 driver_state["is_epoch_end"] = True
                 count_this_epoch = 0
@@ -516,9 +610,11 @@ class LocalOptimizer(Optimizer):
                 data_iter = self.dataset.data(train=True)
             fire_val, fire_ckpt = self._fires(driver_state)
             if fire_val or fire_ckpt:
-                # publish params only when validation/checkpoint will read
-                # them (syncing the whole module tree every iteration is
-                # pure host overhead on deep models)
+                # validation/checkpoint read host-visible state: flush the
+                # window first, then publish params (syncing the module
+                # tree every iteration is pure host overhead)
+                self._drain_pending(pending, driver_state,
+                                    "validation/checkpoint trigger")
                 model.sync(params, mstate)
             self._validate(jit_eval, params, mstate, driver_state,
                            fire=fire_val)
@@ -526,6 +622,7 @@ class LocalOptimizer(Optimizer):
                              count_this_epoch, batches_this_epoch,
                              epoch_start_host_rng, fire=fire_ckpt)
 
+        self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()
         model.sync(params, mstate)
         model.evaluate()

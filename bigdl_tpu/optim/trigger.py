@@ -4,6 +4,14 @@ Reference parity: optim/Trigger.scala:21-70 — ``everyEpoch``,
 ``severalIteration(n)``, ``maxEpoch(n)``, ``maxIteration(n)``.
 State keys follow the reference's state Table: ``neval`` (iteration count),
 ``epoch``, plus ``is_epoch_end`` maintained by the optimizers.
+
+``requires`` declares which DEVICE-produced state keys a trigger reads
+(``min_loss`` -> ``{"loss"}``); combinators union their children's sets.
+The async-dispatch train loops consult it (docs/PERFORMANCE.md): a
+trigger that reads ``loss`` forces a readback every iteration so the
+stopping decision sees the true per-step value, while the default
+``max_epoch``/``max_iteration`` paths — pure host counters — let the
+loop dispatch ahead without ever syncing.
 """
 from __future__ import annotations
 
@@ -12,9 +20,11 @@ __all__ = ["Trigger", "every_epoch", "several_iteration", "max_epoch",
 
 
 class Trigger:
-    def __init__(self, fn, desc=""):
+    def __init__(self, fn, desc="", requires=frozenset()):
         self._fn = fn
         self._desc = desc
+        #: device-produced state keys the predicate reads (e.g. "loss")
+        self.requires = frozenset(requires)
 
     def __call__(self, state) -> bool:
         return bool(self._fn(state))
@@ -48,12 +58,20 @@ def max_iteration(n: int) -> Trigger:
 
 def min_loss(value: float) -> Trigger:
     return Trigger(lambda s: s.get("loss", float("inf")) < value,
-                   f"minLoss({value})")
+                   f"minLoss({value})", requires={"loss"})
+
+
+def _combined(op, name, triggers):
+    desc = f"{name}({', '.join(t._desc for t in triggers)})"
+    requires = frozenset().union(
+        *(getattr(t, "requires", frozenset()) for t in triggers))
+    return Trigger(lambda s: op(t(s) for t in triggers), desc,
+                   requires=requires)
 
 
 def or_trigger(*triggers: Trigger) -> Trigger:
-    return Trigger(lambda s: any(t(s) for t in triggers), "or")
+    return _combined(any, "or", triggers)
 
 
 def and_trigger(*triggers: Trigger) -> Trigger:
-    return Trigger(lambda s: all(t(s) for t in triggers), "and")
+    return _combined(all, "and", triggers)

@@ -15,7 +15,7 @@ MODULES = {
     "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
                 "tests/test_native_loader.py"],
     "optim": ["tests/test_optim.py", "tests/test_checkpoint.py",
-              "tests/test_predictor.py"],
+              "tests/test_predictor.py", "tests/test_async_dispatch.py"],
     "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",
                  "tests/test_tensor_parallel.py",
                  "tests/test_pipeline_parallel.py",

@@ -92,3 +92,64 @@ def test_main_probe_failure_exits_3_with_structured_row(monkeypatch,
     assert lines[0]["value"] == 0.0
     agg = lines[-1]
     assert agg["rows"][0]["error"] == "backend wedged"
+
+
+def test_probe_failure_emits_row_per_requested_metric(monkeypatch,
+                                                      capsys):
+    """BENCH_r05 follow-up: a wedged backend must report EVERY requested
+    row as a structured error immediately, not just the headline."""
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (None, "init timed out"))
+    with pytest.raises(SystemExit) as ei:
+        bench.main(["--rows", "headline,transformer,decode"])
+    assert ei.value.code == 3
+    lines = _parse_lines(capsys.readouterr().out)
+    agg = lines[-1]
+    assert [r["metric"] for r in agg["rows"]] == [
+        "inception_v1_train_images_per_sec_per_chip", "transformer",
+        "decode"]
+    assert all("timed out" in r["error"] for r in agg["rows"])
+    # the per-row error lines were emitted immediately, before the
+    # aggregate
+    assert len(lines) == 4
+    assert all("error" in line for line in lines[:-1])
+
+
+def _probe_timeout_seen(monkeypatch):
+    seen = {}
+
+    def fake_probe(timeout_s):
+        seen["timeout"] = timeout_s
+        return None, "wedged"
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    return seen
+
+
+def test_init_timeout_env_knob(monkeypatch):
+    """BIGDL_TPU_BENCH_INIT_TIMEOUT controls the backend-init timeout and
+    beats the legacy BENCH_PROBE_TIMEOUT_S name."""
+    seen = _probe_timeout_seen(monkeypatch)
+    monkeypatch.setenv("BIGDL_TPU_BENCH_INIT_TIMEOUT", "7.5")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "333")
+    with pytest.raises(SystemExit):
+        bench.main([])
+    assert seen["timeout"] == 7.5
+
+
+def test_init_timeout_default_well_under_tier1_budget(monkeypatch):
+    """With no env override the probe must give up long before the 870 s
+    tier-1 budget (round-5 hung the full legacy 300 s)."""
+    seen = _probe_timeout_seen(monkeypatch)
+    monkeypatch.delenv("BIGDL_TPU_BENCH_INIT_TIMEOUT", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_TIMEOUT_S", raising=False)
+    with pytest.raises(SystemExit):
+        bench.main([])
+    assert seen["timeout"] <= 300.0 < 870.0
+
+
+def test_init_timeout_flag_beats_env(monkeypatch):
+    seen = _probe_timeout_seen(monkeypatch)
+    monkeypatch.setenv("BIGDL_TPU_BENCH_INIT_TIMEOUT", "7.5")
+    with pytest.raises(SystemExit):
+        bench.main(["--probe-timeout", "2.5"])
+    assert seen["timeout"] == 2.5

@@ -343,12 +343,17 @@ class TestOptimizerIntegration:
         for ev in events:
             assert "ph" in ev and "ts" in ev and "name" in ev
         names = {e["name"] for e in events}
-        assert {"host input", "compile step", "device step",
+        assert {"host input", "compile step", "device step", "loss drain",
                 "validation"} <= names
+        # async dispatch: the device step span is dispatch-only; the
+        # intentional sync lives in the packed "loss drain" span
         dstep = [e for e in events if e["name"] == "device step"]
         assert len(dstep) == 3
-        assert all(e["args"]["host_sync"] == "loss readback"
-                   for e in dstep)
+        assert all("host_sync" not in e.get("args", {}) for e in dstep)
+        drains = [e for e in events if e["name"] == "loss drain"]
+        assert all(e["args"]["host_sync"] == "packed loss readback"
+                   for e in drains)
+        assert sum(e["args"]["depth"] for e in drains) == 3
         # (b) the reader returns the recorded per-step series
         for tag in ("Loss", "Throughput", "HostInputTime",
                     "DeviceStepTime"):

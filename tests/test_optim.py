@@ -107,6 +107,42 @@ class TestTriggers:
         assert optim.or_trigger(optim.max_epoch(3), optim.max_iteration(1))(
             {"epoch": 1, "neval": 5})
 
+    def test_requires_declares_loss_dependency(self):
+        """Async-dispatch contract (docs/PERFORMANCE.md): loss-reading
+        triggers must advertise it so the loop can fall back to
+        lockstep."""
+        assert optim.min_loss(0.1).requires == {"loss"}
+        assert optim.max_iteration(5).requires == frozenset()
+        assert optim.max_epoch(2).requires == frozenset()
+        assert optim.several_iteration(3).requires == frozenset()
+        assert optim.every_epoch().requires == frozenset()
+
+    def test_requires_propagates_through_combinators(self):
+        assert optim.or_trigger(optim.min_loss(0.1),
+                                optim.max_epoch(3)).requires == {"loss"}
+        assert optim.and_trigger(optim.min_loss(0.1),
+                                 optim.max_iteration(9)).requires \
+            == {"loss"}
+        # nested: and(max_epoch, or(min_loss, severalIteration))
+        nested = optim.and_trigger(
+            optim.max_epoch(3),
+            optim.or_trigger(optim.min_loss(1.0),
+                             optim.several_iteration(2)))
+        assert nested.requires == {"loss"}
+        assert optim.or_trigger(optim.max_epoch(1),
+                                optim.max_iteration(2)).requires \
+            == frozenset()
+
+    def test_combinator_repr_names_children(self):
+        """Deferred-drain log messages name which trigger forced a sync —
+        'or'/'and' alone said nothing."""
+        r = repr(optim.or_trigger(optim.every_epoch(),
+                                  optim.several_iteration(5)))
+        assert "or(everyEpoch, severalIteration(5))" in r
+        r = repr(optim.and_trigger(optim.min_loss(0.5),
+                                   optim.max_epoch(2)))
+        assert "and(minLoss(0.5), maxEpoch(2))" in r
+
 
 class TestValidation:
     def test_top1(self):
