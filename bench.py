@@ -86,6 +86,18 @@ def _emit(row: dict):
     print(json.dumps(row), flush=True)
 
 
+def _record_compile_telemetry(name: str, compiled) -> None:
+    """Export an AOT executable's cost/memory table (FLOPs, bytes
+    accessed, arg/output/temp + peak HBM bytes) as registry gauges so
+    ``--metrics-out`` carries compile telemetry beside the rates."""
+    from bigdl_tpu.observability import compile_watch
+    try:
+        compile_watch.record_executable(name, compiled)
+    except Exception as e:          # telemetry must never fail a row
+        print(f"compile telemetry for {name} unavailable: {e}",
+              file=sys.stderr)
+
+
 def _convnet_pieces(model_name: str):
     import jax
     from bigdl_tpu import models, nn
@@ -143,6 +155,7 @@ def bench_convnet_synthetic(model_name: str, batch: int = BATCH,
                               labels).compile()
     cost = compiled.cost_analysis()
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    _record_compile_telemetry(f"bench_{model_name}_train_step", compiled)
 
     for _ in range(WARMUP):
         rng, k = jax.random.split(rng)
@@ -432,6 +445,7 @@ def bench_transformer_lm(b: int = 4, s: int = 2048, vocab: int = 32768,
         params, mstate, opt_state, data, labels).compile()
     cost = c.cost_analysis()
     xla_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    _record_compile_telemetry("bench_transformer_lm_train_step", c)
     # analytic step FLOPs: matmul params = 2-D weight leaves minus the
     # embedding tables (lookups, not matmuls)
     p2d = sum(int(np.prod(l.shape))
@@ -674,6 +688,12 @@ def main(argv=None):
                         help="write the metric-registry state here "
                              "after the run (.json -> JSON dump, else "
                              "Prometheus text exposition)")
+    parser.add_argument("--serve-metrics", type=int, default=None,
+                        metavar="PORT",
+                        help="expose the live registry over HTTP for "
+                             "the duration of the run (/metrics, "
+                             "/metrics.json, /trace, /healthz, "
+                             "/readyz; 0 = ephemeral port)")
     parser.add_argument("--host-probe", type=float, default=None,
                         help=argparse.SUPPRESS)   # subprocess entry
     args = parser.parse_args(argv)
@@ -681,6 +701,26 @@ def main(argv=None):
         _emit({"host_pipeline_img_per_sec":
                round(host_pipeline_probe(args.host_probe), 1)})
         return
+    global _metrics_server
+    if args.serve_metrics is not None:
+        from bigdl_tpu.observability.exporter import MetricsServer
+        _metrics_server = MetricsServer(port=args.serve_metrics).start()
+        print(f"# telemetry plane: {_metrics_server.url}",
+              file=sys.stderr)
+    try:
+        return _run(args)
+    finally:
+        if _metrics_server is not None:
+            _metrics_server.close()
+            _metrics_server = None
+
+
+# the live exporter for the current run (None outside one) — tests and
+# embedding harnesses read the bound port here
+_metrics_server = None
+
+
+def _run(args):
     rows = (["headline"] if args.headline_only
             else [r.strip() for r in args.rows.split(",")])
     if args.rows == "all" and not args.headline_only:

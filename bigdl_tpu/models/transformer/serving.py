@@ -40,6 +40,7 @@ import numpy as np
 from bigdl_tpu.models.transformer.generate import (
     GenerationConfig, _embed, _ffn, _linear, _ln, _logits, _model_parts,
     _proj, _sample, _split_heads)
+from bigdl_tpu.observability import compile_watch as _compile_watch
 from bigdl_tpu.observability import trace
 from bigdl_tpu.observability.registry import default_registry
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
@@ -773,12 +774,23 @@ class ContinuousBatcher:
     compiled programs — it adds no dispatches and no device syncs
     beyond the token readback the loop already does (test-pinned by a
     compile/dispatch count).
+
+    Telemetry plane (docs/OBSERVABILITY.md): the batcher registers a
+    ``serving_batcher`` READINESS check (``health=`` — the process
+    default unless given; one batcher per process answers it, the
+    latest registration wins) reporting admitting/saturated, and wraps
+    its prefill/decode step fns in ``compile_watch`` — prompt-bucket
+    explosion or a burst-size churn shows up as
+    ``compile_watch_compiles_total{name="serving_prefill"|
+    "serving_decode"}`` and storm-warns instead of silently paying an
+    XLA compile per request.
     """
 
     def __init__(self, model, *, max_batch: int, num_pages: int,
                  page_size: int = 16, max_new_tokens: int = 32,
                  max_burst: int = 8, eos_id: int | None = None,
-                 registry=None, summary=None):
+                 registry=None, summary=None, health=None,
+                 watch=None):
         meta = model.lm_meta
         self.model = model
         self.max_batch = max_batch
@@ -834,6 +846,35 @@ class ContinuousBatcher:
         self._m_tok_lat = reg.histogram(
             "serving_decode_token_seconds",
             "per-token decode latency: burst wall clock / burst")
+        # compile telemetry: signature-keyed compile counting on the
+        # two step fns (module globals resolve at call time, so tests
+        # that monkeypatch paged_prefill/paged_decode still intercept)
+        self._watch = watch or _compile_watch.CompileWatch(registry=reg)
+        self._prefill_fn = self._watch.watch(
+            lambda *a, **k: paged_prefill(*a, **k),
+            name="serving_prefill")
+        self._decode_fn = self._watch.watch(
+            lambda *a, **k: paged_decode(*a, **k),
+            name="serving_decode")
+        # serving readiness: the load-balancer gate (/readyz)
+        if health is None:
+            from bigdl_tpu.observability.exporter import default_health
+            health = default_health()
+        self._health = health
+        self._health.register("serving_batcher", self._ready,
+                              kind="readiness")
+
+    def _ready(self):
+        """Readiness = admitting: a free slot exists, or nothing is
+        waiting (back-pressure flips this off when every slot is busy
+        AND requests queue behind them)."""
+        free_slots = sum(s is None for s in self.slots)
+        if free_slots > 0:
+            return True, (f"admitting ({free_slots}/{self.max_batch} "
+                          f"slots, {self.cache.pages_free} pages free)")
+        return (not self.queue,
+                f"saturated: 0/{self.max_batch} slots free, "
+                f"{len(self.queue)} queued")
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -891,9 +932,12 @@ class ContinuousBatcher:
             with trace.span("prefill", cat="serving", bucket=bucket,
                             prompt_len=len(prompt),
                             host_sync="first-token readback"):
-                first, _ = paged_prefill(self.model, self.cache,
-                                         row[None, :], padded,
-                                         lengths=[len(prompt)])
+                # lengths as an ARRAY: it is a traced operand, so the
+                # compile-watch signature must key on its shape, not
+                # the per-request value
+                first, _ = self._prefill_fn(
+                    self.model, self.cache, row[None, :], padded,
+                    lengths=np.asarray([len(prompt)], np.int32))
                 # deliberate sync: TTFT is DEFINED by this readback
                 tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
             # TTFT = queue wait + prefill, closed by the readback above
@@ -952,9 +996,9 @@ class ContinuousBatcher:
         with trace.span("decode burst", cat="serving", burst=burst,
                         active=len(active),
                         host_sync="token readback"):
-            toks, new_len = paged_decode(self.model, self.cache,
-                                         self.table, self.lengths,
-                                         self.last, n_new=burst)
+            toks, new_len = self._decode_fn(self.model, self.cache,
+                                            self.table, self.lengths,
+                                            self.last, n_new=burst)
             toks = np.asarray(toks)
         dt = time.monotonic() - t0
         self._m_tok_lat.observe(dt / burst)

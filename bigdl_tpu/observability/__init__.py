@@ -1,28 +1,42 @@
-"""bigdl_tpu.observability — traces, metrics, and summaries.
+"""bigdl_tpu.observability — traces, metrics, summaries, telemetry.
 
 Host-side observability spanning training and serving (reference
 parity: the named per-iteration ``Metrics`` + per-module timing hooks,
 SURVEY §2.7/§7, grown into the BigDL line's TrainSummary/
 ValidationSummary visualization API — arXiv:1804.05839, 2204.01715).
-Three pillars:
+Pillars:
 
-- ``registry``  — process-wide Counter/Gauge/Histogram registry with
-  Prometheus text exposition and a JSON dump
+- ``registry``        — process-wide Counter/Gauge/Histogram registry
+  with Prometheus text exposition and a JSON dump
   (:func:`default_registry`).
-- ``trace``     — span tracer (``trace.span("device step")``) that
-  exports Chrome trace-event JSON for chrome://tracing / Perfetto,
-  with explicit host-sync annotations.
-- ``summary``   — TrainSummary/ValidationSummary scalar event logs
-  (JSONL) plus :class:`SummaryReader` for replay.
+- ``trace``           — span tracer (``trace.span("device step")``)
+  that exports Chrome trace-event JSON for chrome://tracing /
+  Perfetto, with explicit host-sync annotations and event taps.
+- ``summary``         — TrainSummary/ValidationSummary scalar event
+  logs (JSONL) plus :class:`SummaryReader` for replay (live-tail safe).
+- ``exporter``        — :class:`MetricsServer`, an opt-in stdlib HTTP
+  server exposing /metrics, /metrics.json, /trace, /healthz, /readyz
+  over a pluggable :class:`HealthRegistry` (:func:`default_health`).
+- ``compile_watch``   — XLA compile/memory telemetry: ``watch()``
+  wraps jitted callables, counts compiles by abstract-shape signature,
+  exports cost/memory analysis, and warns on recompile storms.
+- ``flight_recorder`` — :class:`FlightRecorder`, a bounded black-box
+  ring that dumps a postmortem directory on abnormal exit.
 
 HOST-ONLY CONTRACT: nothing in this package imports jax at module top
-level (dev/lint.py enforces it) and nothing here blocks on a device
-value — instrumentation wraps compiled steps from the outside, so
-enabling observability never changes what XLA compiles or when the
+level (jaxlint rule JX5 enforces it) and nothing here blocks on a
+device value — instrumentation wraps compiled steps from the outside,
+so enabling observability never changes what XLA compiles or when the
 host syncs (pinned by tests/test_observability.py compile/dispatch
 counts).
 """
+from bigdl_tpu.observability import compile_watch  # noqa: F401
 from bigdl_tpu.observability import tracing as trace  # noqa: F401
+from bigdl_tpu.observability.exporter import (HealthCheck,
+                                              HealthRegistry,
+                                              MetricsServer,
+                                              default_health)
+from bigdl_tpu.observability.flight_recorder import FlightRecorder
 from bigdl_tpu.observability.registry import (Counter, Gauge, Histogram,
                                               MetricRegistry,
                                               default_registry,
@@ -35,4 +49,6 @@ from bigdl_tpu.observability.tracing import Tracer
 __all__ = ["trace", "Tracer", "Counter", "Gauge", "Histogram",
            "MetricRegistry", "default_registry", "sanitize_name",
            "Summary", "TrainSummary", "ValidationSummary",
-           "SummaryReader"]
+           "SummaryReader", "MetricsServer", "HealthCheck",
+           "HealthRegistry", "default_health", "FlightRecorder",
+           "compile_watch"]

@@ -1,0 +1,242 @@
+"""HTTP exposition: /metrics, /metrics.json, /trace, /healthz, /readyz.
+
+The telemetry plane's front door. PR 1 gave every subsystem a
+process-wide metric registry and a span tracer, but only the owning
+process could read them; a long-running ``ContinuousBatcher`` or a
+multi-hour ``DistriOptimizer`` run had no scrape target and no health
+probe. BigDL's operating premise was that training jobs run as ordinary
+cluster citizens with standard operational tooling (arXiv:1804.05839;
+BigDL 2.0's production-pipeline doubling-down, arXiv:2204.01715) — on a
+JAX/TPU stack that means a Prometheus endpoint and k8s-style
+liveness/readiness probes, served by the stdlib so serving images stay
+dependency-free.
+
+Endpoints (GET):
+
+- ``/metrics``        Prometheus text exposition of the registry.
+- ``/metrics.json``   the registry's ``dump()`` as JSON.
+- ``/trace``          Chrome trace JSON from the live tracer (open the
+  response body in ui.perfetto.dev).
+- ``/healthz``        liveness checks (process up + registered
+  ``kind="liveness"`` checks) — 200 ok / 503 failing, JSON body.
+- ``/readyz``         readiness checks (``kind="readiness"``) — the
+  load-balancer gate. A batcher that cannot admit reports not-ready.
+
+Health checks are pluggable: ``default_health().register(name, fn,
+kind=...)`` where ``fn() -> (ok, detail)``. The optimizers register a
+training-liveness check (step progressed within a deadline); the
+continuous batcher registers serving readiness (admitting).
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5); every handler
+reads host state under locks. Serving a scrape can never add a device
+sync or a compile. The server is opt-in, binds ``127.0.0.1`` by
+default, supports port 0 (ephemeral — read ``server.port``), and runs
+daemon threads only, so it can never hold a training process alive.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+__all__ = ["HealthCheck", "HealthRegistry", "default_health",
+           "MetricsServer"]
+
+
+class HealthCheck:
+    """One named probe: ``fn() -> (ok, detail)`` (a bare bool is also
+    accepted). ``kind`` is ``"liveness"`` (/healthz) or ``"readiness"``
+    (/readyz)."""
+
+    KINDS = ("liveness", "readiness")
+
+    def __init__(self, name: str, fn, kind: str = "readiness"):
+        if kind not in self.KINDS:
+            raise ValueError(f"health check kind must be one of "
+                             f"{self.KINDS}, got {kind!r}")
+        self.name = str(name)
+        self.fn = fn
+        self.kind = kind
+
+    def run(self) -> tuple[bool, str]:
+        """Never raises: a crashing probe reports itself as failing."""
+        try:
+            out = self.fn()
+        except Exception as e:
+            return False, f"check raised {type(e).__name__}: {e}"
+        if isinstance(out, tuple):
+            ok, detail = out
+            return bool(ok), str(detail)
+        return bool(out), ""
+
+
+class HealthRegistry:
+    """Name -> check map. Re-registering a name replaces the old check
+    (a restarted batcher takes over its probe); ``unregister`` on
+    shutdown so a dead component stops answering for the process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._checks: dict[str, HealthCheck] = {}
+
+    def register(self, name: str, fn, *,
+                 kind: str = "readiness") -> HealthCheck:
+        check = HealthCheck(name, fn, kind)
+        with self._lock:
+            self._checks[name] = check
+        return check
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._checks.pop(name, None)
+
+    def checks(self, kind: str | None = None) -> list[HealthCheck]:
+        with self._lock:
+            out = [self._checks[n] for n in sorted(self._checks)]
+        if kind is not None:
+            out = [c for c in out if c.kind == kind]
+        return out
+
+    def run(self, kind: str) -> tuple[bool, dict]:
+        """Run every check of ``kind``. With none registered the
+        verdict is ok — an empty process that answers HTTP is alive,
+        and ready-by-default matches a component-free harness."""
+        results = {}
+        ok = True
+        for c in self.checks(kind):
+            c_ok, detail = c.run()
+            ok = ok and c_ok
+            results[c.name] = {"ok": c_ok, "detail": detail}
+        return ok, results
+
+
+_DEFAULT_HEALTH = HealthRegistry()
+
+
+def default_health() -> HealthRegistry:
+    """The process-wide health registry the default server exposes
+    (components take ``health=`` to isolate, like ``registry=``)."""
+    return _DEFAULT_HEALTH
+
+
+class MetricsServer:
+    """Opt-in ``ThreadingHTTPServer`` over the live registry/tracer.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    ``start()`` returns self; ``close()`` shuts down and joins — no
+    non-daemon threads survive it (test-pinned). Usable as a context
+    manager. One scrape surface shows training, serving and bench
+    series side by side because everything defaults to the process-wide
+    registry.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
+                 registry=None, tracer=None, health=None):
+        if registry is None:
+            from bigdl_tpu.observability.registry import default_registry
+            registry = default_registry()
+        if tracer is None:
+            from bigdl_tpu.observability.tracing import get_tracer
+            tracer = get_tracer()
+        self.registry = registry
+        self.tracer = tracer
+        self.health = health if health is not None else default_health()
+        self._host = host
+        self._want_port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    # -- lifecycle --
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = _make_server(self._host, self._want_port, self)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="bigdl-metrics-server", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int | None:
+        return None if self._httpd is None else \
+            self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str | None:
+        return None if self._httpd is None else \
+            f"http://{self._host}:{self.port}"
+
+    def close(self) -> None:
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- endpoint bodies (handler-independent, unit-testable) --
+    def render(self, path: str) -> tuple[int, str, bytes]:
+        """(status, content_type, body) for a request path."""
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    self.registry.expose().encode("utf-8"))
+        if path == "/metrics.json":
+            return (200, "application/json",
+                    self.registry.dump_json().encode("utf-8"))
+        if path == "/trace":
+            return (200, "application/json",
+                    json.dumps(self.tracer.to_dict()).encode("utf-8"))
+        if path in ("/healthz", "/readyz"):
+            kind = "liveness" if path == "/healthz" else "readiness"
+            ok, results = self.health.run(kind)
+            body = json.dumps({"status": "ok" if ok else "failing",
+                               "kind": kind, "checks": results},
+                              sort_keys=True).encode("utf-8")
+            return (200 if ok else 503, "application/json", body)
+        if path in ("/", ""):
+            body = ("bigdl_tpu telemetry plane\n"
+                    "endpoints: /metrics /metrics.json /trace "
+                    "/healthz /readyz\n").encode("utf-8")
+            return 200, "text/plain; charset=utf-8", body
+        return (404, "text/plain; charset=utf-8",
+                f"unknown path {path!r}\n".encode("utf-8"))
+
+
+def _make_server(host: str, port: int, owner: MetricsServer):
+    # stdlib imports live here so importing this module costs nothing
+    # in processes that never serve
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "bigdl-tpu-metrics/1.0"
+
+        def do_GET(self):          # noqa: N802 (stdlib API)
+            try:
+                status, ctype, body = owner.render(self.path)
+            except Exception as e:   # a scrape must never crash serving
+                status, ctype = 500, "text/plain; charset=utf-8"
+                body = f"exporter error: {type(e).__name__}: {e}\n" \
+                    .encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            import logging
+            logging.getLogger("bigdl_tpu.observability.exporter").debug(
+                "%s - %s", self.address_string(), fmt % args)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    return httpd

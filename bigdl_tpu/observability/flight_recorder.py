@@ -1,0 +1,274 @@
+"""Crash flight recorder: a bounded black box + postmortem dumps.
+
+A multi-hour ``DistriOptimizer`` run or a long-lived serving process
+that dies at 3am leaves nothing behind unless someone was tailing logs.
+The flight recorder keeps a BOUNDED ring of the most recent telemetry
+events (trace spans/instants via a tracer tap, warning-level-and-up log
+records via a logging handler, plus anything recorded explicitly) and,
+on abnormal exit, writes a self-contained postmortem directory:
+
+    postmortem/
+      exception.json       what killed it (type, message, traceback)
+      registry.json        full metric-registry dump at death
+      trace.json           the live tracer buffer (Chrome trace JSON)
+      events.jsonl         the ring: last-N spans/instants/log records
+      compile_watch.json   the compile ledger (recompile-storm evidence)
+
+``install()`` arms process-level hooks — ``sys.excepthook`` (chained),
+``SIGTERM`` (main thread only; the k8s eviction signal), and an
+``atexit`` backstop that dumps if an error was observed but never
+dumped — so even a crash outside any try/except leaves the black box.
+The optimizers additionally dump EXPLICITLY when their loop raises
+(the exception may be caught upstream, where no excepthook ever fires).
+
+Cost model: steady-state recording is a deque append per event and
+nothing else — cheap enough to leave on by default in the optimizers.
+All I/O happens only at dump time.
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5); a dump reads host
+state only and never blocks on a device value.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["FlightRecorder", "default_postmortem_dir"]
+
+logger = logging.getLogger("bigdl_tpu.observability.flight_recorder")
+
+
+def default_postmortem_dir() -> str:
+    """``$BIGDL_TPU_POSTMORTEM_DIR`` or a per-pid tmp directory."""
+    env = os.environ.get("BIGDL_TPU_POSTMORTEM_DIR")
+    if env:
+        return env
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"bigdl_tpu_postmortem_{os.getpid()}")
+
+
+class _RingHandler(logging.Handler):
+    """Feeds WARNING+ log records into the recorder's ring."""
+
+    def __init__(self, recorder: "FlightRecorder"):
+        super().__init__(level=logging.WARNING)
+        self._recorder = recorder
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._recorder.record(
+                "log", record.name, level=record.levelname,
+                message=record.getMessage())
+            if record.levelno >= logging.ERROR:
+                self._recorder._saw_error = True
+        except Exception:
+            pass                    # the black box must never crash
+
+
+class FlightRecorder:
+    """Bounded event ring + postmortem writer.
+
+    ``install()``/``uninstall()`` are refcounted (nested optimizers
+    share one set of process hooks); a dump is once-per-reason
+    idempotent so excepthook + atexit can't double-write.
+    """
+
+    def __init__(self, dir: str | None = None, max_events: int = 512,
+                 *, registry=None, tracer=None, watch=None,
+                 logger_name: str = "bigdl_tpu"):
+        self.dir = dir or default_postmortem_dir()
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(max_events))
+        self._registry = registry
+        self._tracer = tracer
+        self._watch = watch
+        self._logger_name = logger_name
+        self._lock = threading.Lock()
+        self._installs = 0
+        self._handler: _RingHandler | None = None
+        self._prev_excepthook = None
+        self._prev_sigterm = None
+        self._saw_error = False
+        self._dumped = False
+
+    # -- dependency resolution (process-wide defaults, lazily) --
+    def _get_registry(self):
+        if self._registry is None:
+            from bigdl_tpu.observability.registry import default_registry
+            return default_registry()
+        return self._registry
+
+    def _get_tracer(self):
+        if self._tracer is None:
+            from bigdl_tpu.observability.tracing import get_tracer
+            return get_tracer()
+        return self._tracer
+
+    def _get_watch(self):
+        if self._watch is None:
+            from bigdl_tpu.observability.compile_watch import default_watch
+            return default_watch()
+        return self._watch
+
+    # -- recording --
+    def record(self, kind: str, name: str, **fields) -> None:
+        """Append one event to the ring (a deque append — safe at any
+        frequency)."""
+        ev = {"t": time.time(), "kind": kind, "name": name}
+        if fields:
+            ev.update(fields)
+        self._ring.append(ev)
+
+    def _tap(self, ev: dict) -> None:
+        self.record("trace", ev.get("name", "?"),
+                    ph=ev.get("ph"), cat=ev.get("cat"),
+                    ts=ev.get("ts"), dur=ev.get("dur"),
+                    args=ev.get("args"))
+
+    def events(self) -> list[dict]:
+        return list(self._ring)
+
+    # -- process hooks --
+    def install(self) -> "FlightRecorder":
+        with self._lock:
+            self._installs += 1
+            if self._installs > 1:
+                return self
+        self._get_tracer().add_tap(self._tap)
+        self._handler = _RingHandler(self)
+        logging.getLogger(self._logger_name).addHandler(self._handler)
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        try:
+            import signal
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+        except ValueError:          # not the main thread
+            self._prev_sigterm = None
+        atexit.register(self._atexit)
+        return self
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if self._installs == 0:
+                return
+            self._installs -= 1
+            if self._installs > 0:
+                return
+        self._get_tracer().remove_tap(self._tap)
+        if self._handler is not None:
+            logging.getLogger(self._logger_name) \
+                .removeHandler(self._handler)
+            self._handler = None
+        if sys.excepthook is self._excepthook:
+            sys.excepthook = self._prev_excepthook
+        self._prev_excepthook = None
+        if self._prev_sigterm is not None:
+            try:
+                import signal
+                if signal.getsignal(signal.SIGTERM) is self._on_sigterm:
+                    signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:
+            pass
+
+    @property
+    def installed(self) -> bool:
+        return self._installs > 0
+
+    def __enter__(self) -> "FlightRecorder":
+        return self.install()
+
+    def __exit__(self, tp, val, tb):
+        if val is not None:
+            self.dump_postmortem(val, reason="context exception")
+        self.uninstall()
+        return False
+
+    # -- exit paths --
+    def _excepthook(self, tp, val, tb):
+        try:
+            self.dump_postmortem(val, reason="uncaught exception",
+                                 tb=tb)
+        finally:
+            (self._prev_excepthook or sys.__excepthook__)(tp, val, tb)
+
+    def _on_sigterm(self, signum, frame):
+        self.dump_postmortem(None, reason="SIGTERM")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+            return
+        # default disposition: terminate with the conventional 128+15
+        raise SystemExit(128 + signum)
+
+    def _atexit(self):
+        # backstop only: an ERROR-level record was seen but nothing
+        # dumped (e.g. the error was logged, swallowed, and the process
+        # wound down "normally")
+        if self._saw_error and not self._dumped:
+            self.dump_postmortem(None, reason="atexit after error")
+
+    # -- the dump --
+    def dump_postmortem(self, exc: BaseException | None = None, *,
+                        reason: str = "exception", tb=None) -> str:
+        """Write the postmortem directory; returns its path. Never
+        raises — a broken dump logs and gives back the dir path."""
+        with self._lock:
+            self._dumped = True
+        d = self.dir
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            logger.error("flight recorder cannot create %s: %s", d, e)
+            return d
+        record = {"reason": reason, "time": time.time(),
+                  "pid": os.getpid(),
+                  "argv": list(getattr(sys, "argv", []))}
+        if exc is not None:
+            record["exception"] = {
+                "type": type(exc).__name__, "message": str(exc),
+                "traceback": "".join(traceback.format_exception(
+                    type(exc), exc, tb if tb is not None
+                    else exc.__traceback__)),
+            }
+        for fname, writer in (
+                ("exception.json",
+                 lambda p: _write_json(p, record)),
+                ("registry.json",
+                 lambda p: self._get_registry().dump_json(p)),
+                ("trace.json",
+                 lambda p: self._get_tracer().export(p)),
+                ("events.jsonl", self._write_events),
+                ("compile_watch.json",
+                 lambda p: _write_json(p, self._get_watch().table()))):
+            try:
+                writer(os.path.join(d, fname))
+            except Exception as e:
+                logger.error("flight recorder failed writing %s: %s",
+                             fname, e)
+        logger.warning("flight recorder postmortem (%s) written to %s",
+                       reason, d)
+        return d
+
+    def _write_events(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev, default=repr) + "\n")
+
+
+def _write_json(path: str, obj) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=2, sort_keys=True, default=repr)

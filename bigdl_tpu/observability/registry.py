@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
 
@@ -297,6 +298,9 @@ class MetricRegistry:
     def dump_json(self, path: str | None = None) -> str:
         text = json.dumps(self.dump(), indent=2, sort_keys=True)
         if path is not None:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
             with open(path, "w", encoding="utf-8") as f:
                 f.write(text)
         return text

@@ -98,19 +98,31 @@ class SummaryReader:
         self.path = path
 
     def records(self) -> list[dict]:
-        out = []
+        """Parse every complete record. Tailing a LIVE file can catch
+        the writer mid-line: a final line with no terminating newline
+        is an in-flight write and is skipped (only that one). A
+        newline-TERMINATED corrupt line is real corruption and still
+        fails loudly."""
         with open(self.path, encoding="utf-8") as f:
-            for ln, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError as e:
-                    raise ValueError(
-                        f"{self.path}:{ln}: corrupt summary line "
-                        f"({e})") from e
-                out.append(rec)
+            text = f.read()
+        terminated = text.endswith("\n")
+        lines = text.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        out = []
+        for ln, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                if ln == len(lines) and not terminated:
+                    break          # live tail: incomplete final line
+                raise ValueError(
+                    f"{self.path}:{ln}: corrupt summary line "
+                    f"({e})") from e
+            out.append(rec)
         return out
 
     def tags(self) -> list[str]:

@@ -53,6 +53,9 @@ class Tracer:
         self._enabled = bool(enabled)
         self._t0 = time.monotonic()
         self._pid = os.getpid()
+        # taps see every event even while export-tracing is off — the
+        # flight recorder's ring buffer rides here (flight_recorder.py)
+        self._taps: list = []
 
     # -- lifecycle --
     def enable(self):
@@ -73,11 +76,33 @@ class Tracer:
             self._dropped = 0
         return self
 
+    # -- taps (flight recorder et al.) --
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(event_dict)`` to every span/instant/counter
+        event, INDEPENDENT of the enabled flag — a disabled tracer with
+        a tap still builds events (but buffers nothing). Tap errors are
+        swallowed: observability must never take down the loop."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
+
     # -- recording --
     def _now_us(self) -> float:
         return (time.monotonic() - self._t0) * 1e6
 
     def _emit(self, ev: dict) -> None:
+        for tap in list(self._taps):
+            try:
+                tap(ev)
+            except Exception:
+                pass
+        if not self._enabled:
+            return
         with self._lock:
             if len(self._events) >= self._max:
                 self._dropped += 1
@@ -89,7 +114,7 @@ class Tracer:
         """Complete-event context manager. Extra kwargs land in the
         event's ``args`` (use ``host_sync="why"`` to mark that the
         wrapped code intentionally blocks on a device value)."""
-        if not self._enabled:
+        if not self._enabled and not self._taps:
             yield
             return
         t0 = self._now_us()
@@ -105,7 +130,7 @@ class Tracer:
             self._emit(ev)
 
     def instant(self, name: str, cat: str = "host", **args):
-        if not self._enabled:
+        if not self._enabled and not self._taps:
             return
         ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
               "ts": self._now_us(), "pid": self._pid,
@@ -116,7 +141,7 @@ class Tracer:
 
     def counter(self, name: str, value: float, cat: str = "host"):
         """Counter-track event (renders as a value-over-time track)."""
-        if not self._enabled:
+        if not self._enabled and not self._taps:
             return
         self._emit({"name": name, "cat": cat, "ph": "C",
                     "ts": self._now_us(), "pid": self._pid,
@@ -145,7 +170,11 @@ class Tracer:
 
     def export(self, path: str) -> str:
         """Write Chrome trace JSON; open in chrome://tracing or
-        ui.perfetto.dev. Returns ``path``."""
+        ui.perfetto.dev. Parent directories are created (a postmortem
+        dump must not fail on a fresh run dir). Returns ``path``."""
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_dict(), f)
         return path

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.observability import trace
+from bigdl_tpu.observability import compile_watch, trace
 from bigdl_tpu.optim.optimizer import Optimizer, _clip_gradients
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 
@@ -130,7 +130,7 @@ class DistriOptimizer(Optimizer):
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(self.metrics.summary())
 
-    def optimize(self):
+    def _optimize_impl(self):
         model, criterion, optim = self.model, self.criterion, \
             self.optim_method
         mesh = self.mesh or get_mesh()
@@ -310,6 +310,11 @@ class DistriOptimizer(Optimizer):
                 if not compiled_steps:
                     self._account_collectives(compiled, n_shards)
                 compiled_steps[shape_key] = compiled
+                # XLA compile/memory telemetry straight off the AOT
+                # executable — compile count, FLOPs, peak HBM land in
+                # the registry (observability/compile_watch.py)
+                compile_watch.note_compile("distri_train_step",
+                                           shape_key, compiled)
             with trace.span("device step"):
                 # dispatch only — loss stays on device; the packed
                 # readback happens at drain time (docs/PERFORMANCE.md).
@@ -322,6 +327,7 @@ class DistriOptimizer(Optimizer):
                         params, mstate, opt_state, step_rng, data,
                         labels, epoch_arr)
             t2 = time.perf_counter()
+            self._telemetry_step()
             n = global_n  # records consumed across all hosts this step
             count_this_epoch += n
             batches_this_epoch += 1

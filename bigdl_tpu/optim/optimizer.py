@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import (to_jax_batch)
-from bigdl_tpu.observability import trace
+from bigdl_tpu.observability import compile_watch, trace
+from bigdl_tpu.observability.flight_recorder import FlightRecorder
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod
 from bigdl_tpu.optim.sgd import SGD
@@ -132,6 +133,15 @@ class Optimizer:
         # async dispatch: how many steps may be in flight before the loop
         # drains their losses with one packed readback (docs/PERFORMANCE.md)
         self.max_in_flight = 2
+        # telemetry plane (docs/OBSERVABILITY.md): the flight recorder's
+        # black box is ON by default (steady-state cost: a deque append
+        # per warning/span event); the HTTP exporter is opt-in
+        self.flight_recorder: FlightRecorder | None = FlightRecorder()
+        self._metrics_server_cfg = None
+        self._metrics_server = None
+        self._liveness_deadline = 600.0
+        self._last_step_mono = None
+        self._liveness_registered = False
 
     # -- builder API (reference Optimizer.scala:66-123) --
     def set_validation(self, trigger, dataset, methods):
@@ -227,7 +237,97 @@ class Optimizer:
         self.end_when = end_when
         return self
 
+    def set_metrics_server(self, port: int = 0, host: str = "127.0.0.1",
+                           *, liveness_deadline: float = 600.0):
+        """Expose the live telemetry plane over HTTP for the duration
+        of :meth:`optimize`: /metrics (Prometheus text), /metrics.json,
+        /trace, /healthz, /readyz (docs/OBSERVABILITY.md). ``port=0``
+        binds an ephemeral port — read it from
+        ``self._metrics_server.port`` once training starts. A
+        ``training_liveness`` health check reports failing when no step
+        has progressed within ``liveness_deadline`` seconds (warming up
+        before the first step counts as live). Returns self."""
+        if liveness_deadline <= 0:
+            raise ValueError(f"liveness_deadline must be > 0, got "
+                             f"{liveness_deadline}")
+        self._metrics_server_cfg = {"port": int(port), "host": host}
+        self._liveness_deadline = float(liveness_deadline)
+        return self
+
+    def set_flight_recorder(self, recorder=None):
+        """Replace the default crash flight recorder: pass a
+        :class:`FlightRecorder`, a directory path (a recorder dumping
+        there), or None to disable. On by default — an optimizer run
+        that dies leaves a postmortem directory (registry JSON, trace
+        JSON, last-N events, compile ledger, exception). Returns
+        self."""
+        if isinstance(recorder, str):
+            recorder = FlightRecorder(dir=recorder)
+        self.flight_recorder = recorder
+        return self
+
+    # -- telemetry plane lifecycle (docs/OBSERVABILITY.md) --
+    def _liveness_check(self):
+        last = self._last_step_mono
+        if last is None:
+            return True, "no step yet (warming up)"
+        age = time.monotonic() - last
+        return (age <= self._liveness_deadline,
+                f"last step {age:.1f}s ago "
+                f"(deadline {self._liveness_deadline:.0f}s)")
+
+    def _telemetry_step(self) -> None:
+        """Heartbeat: one monotonic read per iteration, feeding the
+        training_liveness health check."""
+        self._last_step_mono = time.monotonic()
+
+    def _telemetry_start(self) -> None:
+        self._last_step_mono = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.install()
+        from bigdl_tpu.observability.exporter import default_health
+        default_health().register("training_liveness",
+                                  self._liveness_check, kind="liveness")
+        self._liveness_registered = True
+        if self._metrics_server_cfg is not None:
+            from bigdl_tpu.observability.exporter import MetricsServer
+            cfg = self._metrics_server_cfg
+            self._metrics_server = MetricsServer(cfg["port"],
+                                                 cfg["host"]).start()
+            logger.info("telemetry plane listening on %s "
+                        "(/metrics /metrics.json /trace /healthz "
+                        "/readyz)", self._metrics_server.url)
+
+    def _telemetry_stop(self) -> None:
+        if self._liveness_registered:
+            from bigdl_tpu.observability.exporter import default_health
+            default_health().unregister("training_liveness")
+            self._liveness_registered = False
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.uninstall()
+
     def optimize(self):
+        """Run the training loop with the telemetry plane armed: the
+        metrics server (when configured) and the training-liveness
+        check span the run, and ANY escaping exception leaves a
+        postmortem directory before propagating — the loop may be
+        wrapped in a driver that catches it, where ``sys.excepthook``
+        would never fire."""
+        self._telemetry_start()
+        try:
+            return self._optimize_impl()
+        except BaseException as e:
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump_postmortem(
+                    e, reason="optimizer exception")
+            raise
+        finally:
+            self._telemetry_stop()
+
+    def _optimize_impl(self):
         raise NotImplementedError
 
     # -- shared helpers --
@@ -514,7 +614,7 @@ class Optimizer:
 class LocalOptimizer(Optimizer):
     """Single-host training loop (reference optim/LocalOptimizer.scala)."""
 
-    def optimize(self):
+    def _optimize_impl(self):
         model, criterion, optim = self.model, self.criterion, \
             self.optim_method
         model.materialize()
@@ -546,7 +646,12 @@ class LocalOptimizer(Optimizer):
                                                      opt_state)
             return new_params, new_mstate, new_opt_state, loss
 
-        jit_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # stats=False: pure signature counting — the hot loop must add
+        # zero tracing work; retraces (partial final batches and worse)
+        # still land in compile_watch_compiles_total and storm-warn
+        jit_step = compile_watch.watch(
+            jax.jit(train_step, donate_argnums=(0, 1, 2)),
+            name="local_train_step", stats=False)
 
         def eval_apply(params, mstate, data):
             if self.input_transform is not None:
@@ -583,6 +688,7 @@ class LocalOptimizer(Optimizer):
                     params, mstate, opt_state, step_rng, data, labels,
                     jnp.asarray(driver_state["epoch"], jnp.int32))
             t2 = time.perf_counter()
+            self._telemetry_step()
             n = int(data.shape[0])
             count_this_epoch += n
             batches_this_epoch += 1

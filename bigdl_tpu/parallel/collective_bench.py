@@ -181,6 +181,15 @@ def allreduce_bench(size_mb: float = 54.0, dtype="float32",
         "bus_gbps": round(wire / dt / 1e9, 3),
         "unit": "GB/s",
     }
+    # compile/memory telemetry of the benchmarked executable — the
+    # lower().compile() is a cache hit after the timed loop above
+    from bigdl_tpu.observability import compile_watch
+    try:
+        compile_watch.record_executable(
+            "collective_bench_allreduce", step.lower(x).compile())
+    except Exception:               # telemetry must never fail a bench
+        pass
+
     # export through the process-wide registry so the microbenchmark
     # lands on the same Prometheus/JSON surface as training metrics
     from bigdl_tpu.observability.registry import default_registry

@@ -25,7 +25,8 @@ MODULES = {
     "models": ["tests/test_models.py", "tests/test_transformer.py",
                "tests/test_generate.py", "tests/test_rnn_generate.py",
                "tests/test_serving.py", "tests/test_perf_paths.py"],
-    "observability": ["tests/test_observability.py"],
+    "observability": ["tests/test_observability.py",
+                      "tests/test_telemetry.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],

@@ -153,3 +153,50 @@ def test_init_timeout_flag_beats_env(monkeypatch):
     with pytest.raises(SystemExit):
         bench.main(["--probe-timeout", "2.5"])
     assert seen["timeout"] == 2.5
+
+
+def _get(url):
+    from urllib.request import urlopen
+    with urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def test_serve_metrics_exposes_live_registry(monkeypatch, capsys):
+    """--serve-metrics PORT serves the registry DURING the run (rows
+    scrape their own process here) and tears the server down after."""
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: ("cpu|test|1", None))
+    seen = {}
+
+    def fake_row(name, headline=False):
+        srv = bench._metrics_server
+        assert srv is not None and srv.port > 0
+        status, text = _get(f"{srv.url}/metrics")
+        seen["status"], seen["text"] = status, text
+        _, seen["health"] = _get(f"{srv.url}/healthz")
+        return {"metric": "inception_v1_train_images_per_sec_per_chip",
+                "value": 42.0, "unit": "images/sec/chip",
+                "vs_baseline": 0.28}
+    monkeypatch.setattr(bench, "bench_convnet_synthetic", fake_row)
+    bench.main(["--rows", "headline", "--serve-metrics", "0"])
+    assert seen["status"] == 200
+    assert json.loads(seen["health"])["status"] == "ok"
+    # the scrape happened before this row's gauge was published, but
+    # the endpoint IS the live process registry
+    assert "# TYPE" in seen["text"] or seen["text"] == ""
+    # and the registry now carries the row that ran
+    from bigdl_tpu.observability.registry import default_registry
+    g = default_registry().get(
+        "bench_inception_v1_train_images_per_sec_per_chip")
+    assert g is not None and g.value() == 42.0
+    # server is gone after main returns
+    assert bench._metrics_server is None
+
+
+def test_serve_metrics_closes_on_probe_failure(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (None, "wedged"))
+    with pytest.raises(SystemExit) as ei:
+        bench.main(["--serve-metrics", "0"])
+    assert ei.value.code == 3
+    assert bench._metrics_server is None

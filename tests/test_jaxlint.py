@@ -368,6 +368,24 @@ class TestJX5HostOnlyImports:
         """, rel="bigdl_tpu/observability/tracing.py")
         assert out == []
 
+    def test_telemetry_plane_modules_are_covered(self):
+        """Satellite pin: the host-only prefix covers the telemetry
+        plane — a module-level jax import in exporter.py /
+        flight_recorder.py / compile_watch.py is a JX5 finding (their
+        jax use must stay function-local), and the shipped files are
+        clean."""
+        for mod in ("exporter.py", "flight_recorder.py",
+                    "compile_watch.py"):
+            rel = f"bigdl_tpu/observability/{mod}"
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, "bigdl_tpu", "observability", mod)
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+
 
 class TestSuppressions:
     def test_disable_silences_named_rule(self):

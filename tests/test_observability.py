@@ -140,6 +140,14 @@ class TestRegistry:
         assert data["a_total"]["samples"][0]["value"] == 1
         assert data["h"]["samples"][0]["buckets"]["+Inf"] == 1
 
+    def test_dump_json_creates_parent_dirs(self, tmp_path):
+        reg = MetricRegistry()
+        reg.counter("a_total").inc()
+        path = str(tmp_path / "fresh" / "dir" / "m.json")
+        reg.dump_json(path)
+        with open(path) as f:
+            assert json.load(f)["a_total"]["samples"][0]["value"] == 1
+
     def test_sanitize_name(self):
         assert sanitize_name("device step time") == "device_step_time"
         assert sanitize_name("allreduce GB/s (x)") \
@@ -195,6 +203,16 @@ class TestTracer:
             open(trace.export(str(tmp_path / "t.json"))).read())
         assert data["traceEvents"][0]["name"] == "step"
 
+    def test_export_creates_parent_dirs(self, tmp_path):
+        """Satellite: a postmortem/export path under a fresh run dir
+        must not fail on the missing parent."""
+        t = Tracer(enabled=True)
+        t.instant("e")
+        path = str(tmp_path / "new" / "run" / "trace.json")
+        assert t.export(path) == path
+        with open(path) as f:
+            assert len(json.load(f)["traceEvents"]) == 1
+
 
 # ---------------------------------------------------------------------------
 # summaries
@@ -244,6 +262,28 @@ class TestSummary:
         s.close()
         with open(s.path, "a") as f:
             f.write("not json\n")
+        with pytest.raises(ValueError, match="corrupt"):
+            SummaryReader(s.path).records()
+
+    def test_live_tail_skips_incomplete_final_line(self, tmp_path):
+        """Satellite: tailing a LIVE log can catch the writer mid-line;
+        an unterminated final line is skipped — and only that one."""
+        s = Summary(str(tmp_path), "app")
+        s.add_scalar("t", 1.0, 1)
+        s.add_scalar("t", 2.0, 2)
+        s.close()
+        with open(s.path, "a") as f:
+            f.write('{"step": 3, "wall_time": 1.0, "tag": "t", "va')
+        r = SummaryReader(s.path)
+        assert r.values("t") == [1.0, 2.0]
+        assert r.steps("t") == [1, 2]
+        # a corrupt line in the MIDDLE still fails loudly even when the
+        # file also ends mid-write
+        with open(s.path, "w") as f:
+            f.write('{"step": 1, "wall_time": 1.0, "tag": "t", '
+                    '"value": 1.0}\n')
+            f.write("garbage\n")
+            f.write('{"step": 2, "wall_time": 1.0, "tag": "t", "val')
         with pytest.raises(ValueError, match="corrupt"):
             SummaryReader(s.path).records()
 

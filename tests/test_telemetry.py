@@ -1,0 +1,575 @@
+"""Telemetry-plane tests: HTTP exporter, compile watch, flight recorder.
+
+The acceptance contract (ISSUE 4, pinned on CPU):
+
+- all five exporter endpoints answer on an ephemeral port; /metrics is
+  the registry's own ``expose()`` text, /metrics.json its ``dump()``;
+  /readyz flips with health-check state; shutdown leaves no non-daemon
+  threads;
+- a ``watch()``-wrapped jitted fn records exactly 1 compile for
+  repeated same-shape calls, increments on a shape change, and fires
+  the recompile-storm warning at threshold with the shape diff;
+- a forced exception in a toy optimizer run leaves a complete
+  postmortem directory (valid registry JSON + trace JSON + exception
+  record + event ring + compile ledger);
+- a /metrics scrape of a LIVE optimizer run returns the same counter
+  values as ``default_registry().dump()``.
+"""
+import json
+import logging
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample, SampleToBatch, array
+from bigdl_tpu.observability import (FlightRecorder, HealthRegistry,
+                                     MetricRegistry, MetricsServer,
+                                     Tracer, compile_watch,
+                                     default_registry)
+from bigdl_tpu.observability.compile_watch import (CompileWatch,
+                                                   executable_stats,
+                                                   signature_of)
+
+
+def _get(url):
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+    try:
+        with urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _samples(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 784).astype(np.float32)
+    y = rs.randint(1, 11, size=(n,)).astype(np.int64)
+    return [Sample(x[i], y[i]) for i in range(n)]
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(784, 8), nn.Tanh(),
+                         nn.Linear(8, 10), nn.LogSoftMax())
+
+
+def _optimizer(end_when, batch=16):
+    ds = array(_samples()) >> SampleToBatch(batch)
+    o = optim.Optimizer(model=_mlp(), dataset=ds,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(optim.SGD(learning_rate=0.1)) \
+     .set_end_when(end_when)
+    return o
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+class TestMetricsServer:
+    def test_all_endpoints_on_ephemeral_port(self):
+        reg = MetricRegistry()
+        reg.counter("req_total", "requests").inc(3)
+        reg.gauge("depth").set(2)
+        tracer = Tracer(enabled=True)
+        with tracer.span("unit"):
+            pass
+        health = HealthRegistry()
+        with MetricsServer(port=0, registry=reg, tracer=tracer,
+                           health=health) as srv:
+            assert srv.port > 0
+            # /metrics is EXACTLY the registry's own exposition text
+            status, text = _get(f"{srv.url}/metrics")
+            assert status == 200
+            assert text == reg.expose()
+            assert "req_total 3" in text and "# TYPE depth gauge" in text
+            # /metrics.json mirrors dump()
+            status, body = _get(f"{srv.url}/metrics.json")
+            assert status == 200
+            assert json.loads(body) == json.loads(reg.dump_json())
+            # /trace is the live tracer's Chrome trace JSON
+            status, body = _get(f"{srv.url}/trace")
+            assert status == 200
+            events = json.loads(body)["traceEvents"]
+            assert [e["name"] for e in events] == ["unit"]
+            # health endpoints: empty registries answer ok
+            for path in ("/healthz", "/readyz"):
+                status, body = _get(f"{srv.url}{path}")
+                assert status == 200, path
+                assert json.loads(body)["status"] == "ok"
+            status, _ = _get(f"{srv.url}/nope")
+            assert status == 404
+
+    def test_readyz_flips_with_check_state(self):
+        health = HealthRegistry()
+        state = {"ok": True}
+        health.register("gate", lambda: (state["ok"], "detail here"),
+                        kind="readiness")
+        with MetricsServer(port=0, registry=MetricRegistry(),
+                           health=health) as srv:
+            status, body = _get(f"{srv.url}/readyz")
+            assert status == 200
+            assert json.loads(body)["checks"]["gate"]["ok"] is True
+            state["ok"] = False
+            status, body = _get(f"{srv.url}/readyz")
+            assert status == 503
+            got = json.loads(body)
+            assert got["status"] == "failing"
+            assert got["checks"]["gate"] == {"ok": False,
+                                             "detail": "detail here"}
+            # readiness checks do not bleed into liveness
+            status, _ = _get(f"{srv.url}/healthz")
+            assert status == 200
+
+    def test_crashing_check_reports_failing_not_500(self):
+        health = HealthRegistry()
+        health.register("boom", lambda: 1 / 0, kind="liveness")
+        with MetricsServer(port=0, registry=MetricRegistry(),
+                           health=health) as srv:
+            status, body = _get(f"{srv.url}/healthz")
+            assert status == 503
+            detail = json.loads(body)["checks"]["boom"]["detail"]
+            assert "ZeroDivisionError" in detail
+
+    def test_shutdown_leaves_no_nondaemon_threads(self):
+        before = {t for t in threading.enumerate() if not t.daemon}
+        srv = MetricsServer(port=0, registry=MetricRegistry(),
+                            health=HealthRegistry()).start()
+        _get(f"{srv.url}/metrics")       # exercise a handler thread
+        srv.close()
+        after = {t for t in threading.enumerate() if not t.daemon}
+        assert after <= before
+        # and the serving thread itself is gone
+        assert not any(t.name == "bigdl-metrics-server"
+                       for t in threading.enumerate())
+
+    def test_health_registry_replaces_and_unregisters(self):
+        h = HealthRegistry()
+        h.register("x", lambda: False, kind="readiness")
+        h.register("x", lambda: True, kind="readiness")
+        ok, results = h.run("readiness")
+        assert ok and results["x"]["ok"] is True
+        h.unregister("x")
+        assert h.run("readiness") == (True, {})
+        with pytest.raises(ValueError, match="kind"):
+            h.register("y", lambda: True, kind="wellness")
+
+
+# ---------------------------------------------------------------------------
+# compile watch
+# ---------------------------------------------------------------------------
+
+class TestCompileWatch:
+    def test_one_compile_per_shape_increment_on_change(self):
+        reg = MetricRegistry()
+        cw = CompileWatch(registry=reg, tracer=Tracer())
+        fn = cw.watch(jax.jit(lambda x: (x * 2).sum()), name="double")
+        for _ in range(4):
+            fn(jnp.ones((4, 8)))
+        t = cw.table()["double"]
+        assert t["compiles"] == 1 and t["calls"] == 4
+        assert reg.get("compile_watch_compiles_total") \
+                  .value(name="double") == 1
+        assert reg.get("compile_watch_calls_total") \
+                  .value(name="double") == 4
+        fn(jnp.ones((4, 16)))                 # shape change -> retrace
+        t = cw.table()["double"]
+        assert t["compiles"] == 2
+        assert reg.get("compile_watch_signatures") \
+                  .value(name="double") == 2
+        fn(jnp.ones((4, 16)))                 # repeat: no new compile
+        assert cw.table()["double"]["compiles"] == 2
+
+    def test_cost_stats_exported_for_jitted_fn(self):
+        reg = MetricRegistry()
+        cw = CompileWatch(registry=reg, tracer=Tracer())
+        fn = cw.watch(jax.jit(lambda a, b: a @ b), name="mm")
+        fn(jnp.ones((16, 32)), jnp.ones((32, 8)))
+        stats = cw.table()["mm"]["stats"]
+        assert stats.get("flops", 0) > 0      # CPU cost_analysis works
+        assert reg.get("compile_watch_flops").value(name="mm") \
+            == stats["flops"]
+
+    def test_storm_warning_at_threshold_with_shape_diff(self, caplog):
+        reg = MetricRegistry()
+        cw = CompileWatch(registry=reg, tracer=Tracer(),
+                          storm_threshold=3)
+        fn = cw.watch(jax.jit(lambda x: x.sum()), name="stormy")
+        with caplog.at_level(
+                logging.WARNING,
+                logger="bigdl_tpu.observability.compile_watch"):
+            fn(jnp.ones((1,)))
+            fn(jnp.ones((2,)))
+            assert not [r for r in caplog.records
+                        if "recompile storm" in r.getMessage()]
+            fn(jnp.ones((3,)))                # 3rd signature: threshold
+        warned = [r for r in caplog.records
+                  if "recompile storm" in r.getMessage()]
+        assert len(warned) == 1
+        msg = warned[0].getMessage()
+        assert "'stormy'" in msg and "3 distinct" in msg
+        assert "float32[2] -> float32[3]" in msg      # the shape diff
+        assert reg.get("compile_watch_storms_total") \
+                  .value(name="stormy") == 1
+
+    def test_note_compile_records_aot_executable(self):
+        reg = MetricRegistry()
+        cw = CompileWatch(registry=reg, tracer=Tracer())
+        x = jnp.ones((8, 8))
+        compiled = jax.jit(lambda a: a + 1).lower(x).compile()
+        cw.note_compile("aot_step", ((8, 8), "f32"), compiled)
+        cw.note_compile("aot_step", ((8, 8), "f32"))     # same key
+        t = cw.table()["aot_step"]
+        assert t["compiles"] == 1 and t["calls"] == 2
+        assert "flops" in t["stats"] or "bytes_accessed" in t["stats"]
+
+    def test_signature_keys_shapes_not_values(self):
+        a = signature_of((np.zeros((2, 3), np.float32),), {"n": 4})
+        b = signature_of((np.ones((2, 3), np.float32),), {"n": 4})
+        c = signature_of((np.zeros((2, 4), np.float32),), {"n": 4})
+        d = signature_of((np.zeros((2, 3), np.float32),), {"n": 5})
+        assert a == b                  # values don't key
+        assert a != c                  # shapes do
+        assert a != d                  # statics (python scalars) do
+
+    def test_executable_stats_best_effort(self):
+        class Broken:
+            def cost_analysis(self):
+                raise RuntimeError("nope")
+
+            def memory_analysis(self):
+                raise RuntimeError("nope")
+        assert executable_stats(Broken()) == {}
+
+    def test_stats_false_skips_lowering(self):
+        cw = CompileWatch(registry=MetricRegistry(), tracer=Tracer())
+        lowered = []
+
+        class FakeJit:
+            def __call__(self, x):
+                return x
+
+            def lower(self, *a, **k):
+                lowered.append(1)
+                raise AssertionError("must not lower with stats=False")
+        fn = cw.watch(FakeJit(), name="quiet", stats=False)
+        fn(np.ones((2,), np.float32))
+        assert lowered == [] and cw.table()["quiet"]["compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_taps_disabled_tracer(self):
+        tracer = Tracer(enabled=False)
+        fr = FlightRecorder(dir="/tmp/unused", max_events=4,
+                            tracer=tracer)
+        fr.install()
+        try:
+            for i in range(10):
+                with tracer.span(f"s{i}"):
+                    pass
+        finally:
+            fr.uninstall()
+        events = fr.events()
+        assert len(events) == 4                    # bounded
+        assert [e["name"] for e in events] == ["s6", "s7", "s8", "s9"]
+        assert all(e["kind"] == "trace" for e in events)
+        # export-tracing stayed off: the tracer buffered nothing
+        assert tracer.to_dict()["traceEvents"] == []
+        # uninstalled: no further capture
+        with tracer.span("after"):
+            pass
+        assert len(fr.events()) == 4
+
+    def test_warning_logs_land_in_ring(self):
+        fr = FlightRecorder(dir="/tmp/unused", max_events=8,
+                            tracer=Tracer())
+        fr.install()
+        try:
+            logging.getLogger("bigdl_tpu.optim").warning("ring me %d", 7)
+            logging.getLogger("bigdl_tpu.optim").debug("below level")
+        finally:
+            fr.uninstall()
+        logs = [e for e in fr.events() if e["kind"] == "log"]
+        assert len(logs) == 1
+        assert logs[0]["message"] == "ring me 7"
+        assert logs[0]["level"] == "WARNING"
+
+    def test_dump_postmortem_is_complete(self, tmp_path):
+        reg = MetricRegistry()
+        reg.counter("died_total").inc()
+        tracer = Tracer(enabled=True)
+        with tracer.span("last act"):
+            pass
+        cw = CompileWatch(registry=reg, tracer=tracer)
+        cw.note_compile("step", ("sig",))
+        fr = FlightRecorder(dir=str(tmp_path / "pm" / "deep"),
+                            registry=reg, tracer=tracer, watch=cw)
+        fr.record("note", "custom", x=1)
+        try:
+            raise RuntimeError("the reason")
+        except RuntimeError as e:
+            out = fr.dump_postmortem(e, reason="unit test")
+        assert out == str(tmp_path / "pm" / "deep")   # dirs created
+        with open(os.path.join(out, "exception.json")) as f:
+            exc = json.load(f)
+        assert exc["reason"] == "unit test"
+        assert exc["exception"]["type"] == "RuntimeError"
+        assert "the reason" in exc["exception"]["message"]
+        assert "RuntimeError" in exc["exception"]["traceback"]
+        with open(os.path.join(out, "registry.json")) as f:
+            assert json.load(f)["died_total"]["samples"][0]["value"] == 1
+        with open(os.path.join(out, "trace.json")) as f:
+            names = [e["name"] for e in json.load(f)["traceEvents"]]
+        # the span, plus the compile instant note_compile emitted
+        assert names == ["last act", "compile"]
+        with open(os.path.join(out, "events.jsonl")) as f:
+            evs = [json.loads(line) for line in f]
+        assert evs[-1]["kind"] == "note" and evs[-1]["x"] == 1
+        with open(os.path.join(out, "compile_watch.json")) as f:
+            assert json.load(f)["step"]["compiles"] == 1
+
+    def test_excepthook_chain_dumps_and_forwards(self, tmp_path):
+        import sys
+        fr = FlightRecorder(dir=str(tmp_path), tracer=Tracer())
+        seen = []
+        prev, sys.excepthook = sys.excepthook, \
+            lambda tp, v, tb: seen.append(tp)
+        try:
+            fr.install()
+            try:
+                raise ValueError("crash")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            fr.uninstall()
+            sys.excepthook = prev
+        assert seen == [ValueError]               # chained onward
+        with open(os.path.join(str(tmp_path), "exception.json")) as f:
+            assert json.load(f)["exception"]["type"] == "ValueError"
+
+    def test_install_is_refcounted(self):
+        tracer = Tracer()
+        fr = FlightRecorder(dir="/tmp/unused", tracer=tracer)
+        fr.install()
+        fr.install()
+        fr.uninstall()
+        assert fr.installed                       # one install remains
+        assert tracer._taps                       # tap still live
+        fr.uninstall()
+        assert not fr.installed and not tracer._taps
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: optimizer wiring (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+class _BoomAfter:
+    """end_when trigger that blows up once ``neval`` passes ``n`` — a
+    mid-training crash with steps already on the books."""
+
+    requires = frozenset()
+
+    def __init__(self, n):
+        self.n = n
+
+    def __call__(self, state):
+        if state["neval"] > self.n:
+            raise RuntimeError("injected mid-training failure")
+        return False
+
+
+class TestOptimizerTelemetry:
+    def test_forced_exception_leaves_postmortem(self, tmp_path):
+        pm = str(tmp_path / "postmortem")
+        o = _optimizer(_BoomAfter(2))
+        o.set_flight_recorder(pm)
+        with pytest.raises(RuntimeError, match="injected"):
+            o.optimize()
+        # the complete black box, written although the exception was
+        # caught right here (no excepthook ever fired)
+        with open(os.path.join(pm, "exception.json")) as f:
+            exc = json.load(f)
+        assert exc["reason"] == "optimizer exception"
+        assert exc["exception"]["type"] == "RuntimeError"
+        assert "injected mid-training failure" \
+            in exc["exception"]["message"]
+        with open(os.path.join(pm, "registry.json")) as f:
+            json.load(f)                           # valid registry JSON
+        with open(os.path.join(pm, "trace.json")) as f:
+            json.load(f)["traceEvents"]            # valid trace JSON
+        with open(os.path.join(pm, "events.jsonl")) as f:
+            events = [json.loads(line) for line in f]
+        # the ring caught the loop's spans (tracing itself was off)
+        assert any(e["kind"] == "trace" and e["name"] == "device step"
+                   for e in events)
+        with open(os.path.join(pm, "compile_watch.json")) as f:
+            ledger = json.load(f)
+        assert ledger["local_train_step"]["compiles"] >= 1
+        # hooks are gone after the run
+        assert not o.flight_recorder.installed
+
+    def test_disabled_flight_recorder_writes_nothing(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("BIGDL_TPU_POSTMORTEM_DIR",
+                           str(tmp_path / "off"))
+        o = _optimizer(_BoomAfter(1)).set_flight_recorder(None)
+        with pytest.raises(RuntimeError):
+            o.optimize()
+        assert not os.path.exists(str(tmp_path / "off"))
+
+    def test_live_scrape_matches_registry_dump(self, tmp_path):
+        """Acceptance: /metrics[.json] of a LIVE run returns the same
+        counter values as default_registry().dump()."""
+        seen = {}
+
+        class _ScrapeAt:
+            requires = frozenset()
+
+            def __init__(self, opt, at):
+                self.opt, self.at = opt, at
+
+            def __call__(self, state):
+                if state["neval"] == self.at and "dump" not in seen:
+                    srv = self.opt._metrics_server
+                    assert srv is not None and srv.port > 0
+                    _, seen["json"] = _get(f"{srv.url}/metrics.json")
+                    _, seen["text"] = _get(f"{srv.url}/metrics")
+                    seen["dump"] = default_registry().dump()
+                    seen["expose"] = default_registry().expose()
+                    _, seen["healthz"] = _get(f"{srv.url}/healthz")
+                return state["neval"] > self.at
+            # the loop is parked in this trigger while it scrapes, so
+            # scrape and dump are snapshots of the same quiescent state
+
+        o = _optimizer(None)
+        o.set_end_when(_ScrapeAt(o, 3)) \
+         .set_metrics_server(port=0) \
+         .set_flight_recorder(str(tmp_path))
+        o.optimize()
+        scraped = json.loads(seen["json"])
+        dump = seen["dump"]
+        assert scraped.keys() == dump.keys()
+        for name, metric in dump.items():
+            if metric["type"] != "counter":
+                continue
+            assert scraped[name]["samples"] == metric["samples"], name
+        assert seen["text"] == seen["expose"]
+        # the run registered its training-liveness check, and it was
+        # live (steps were progressing)
+        health = json.loads(seen["healthz"])
+        assert health["checks"]["training_liveness"]["ok"] is True
+        # server + check are torn down with the run
+        assert o._metrics_server is None
+        from bigdl_tpu.observability.exporter import default_health
+        assert all(c.name != "training_liveness"
+                   for c in default_health().checks())
+
+    def test_liveness_check_fails_past_deadline(self, tmp_path):
+        o = _optimizer(optim.max_iteration(1))
+        o.set_metrics_server(port=0, liveness_deadline=60.0) \
+         .set_flight_recorder(str(tmp_path))
+        ok, detail = o._liveness_check()
+        assert ok and "warming up" in detail
+        o._telemetry_step()
+        ok, _ = o._liveness_check()
+        assert ok
+        import time
+        o._last_step_mono = time.monotonic() - 120.0   # stalled
+        ok, detail = o._liveness_check()
+        assert not ok and "deadline" in detail
+        with pytest.raises(ValueError, match="liveness_deadline"):
+            o.set_metrics_server(liveness_deadline=0)
+
+    def test_local_step_compiles_are_counted(self, tmp_path):
+        # the default (process-wide) ledger: this architecture may have
+        # trained earlier in the session, so pin calls, not compiles
+        before = compile_watch.table().get(
+            "local_train_step", {}).get("calls", 0)
+        o = _optimizer(optim.max_iteration(3))
+        o.set_flight_recorder(str(tmp_path))
+        o.optimize()
+        t = compile_watch.table()["local_train_step"]
+        assert t["compiles"] >= 1
+        assert t["calls"] >= before + 3
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: serving wiring
+# ---------------------------------------------------------------------------
+
+V = 32
+
+
+def _lm(seed=0):
+    from bigdl_tpu.models import TransformerLM
+    m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                      max_len=64)
+    m.materialize(jax.random.PRNGKey(seed))
+    m.evaluate()
+    return m
+
+
+class TestBatcherTelemetry:
+    def test_readiness_flips_with_saturation(self):
+        from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+        health = HealthRegistry()
+        reg = MetricRegistry()
+        cb = ContinuousBatcher(_lm(), max_batch=1, num_pages=32,
+                               page_size=4, max_new_tokens=6,
+                               max_burst=4, registry=reg, health=health)
+        ok, results = health.run("readiness")
+        assert ok and results["serving_batcher"]["ok"]
+        assert "admitting" in results["serving_batcher"]["detail"]
+        rs = np.random.RandomState(1)
+        for i in range(2):
+            cb.submit(i, list(rs.randint(1, V + 1, size=(5,))))
+        cb.step(burst=2)           # slot taken, one request queued
+        ok, results = health.run("readiness")
+        assert not ok
+        assert "saturated" in results["serving_batcher"]["detail"]
+        cb.run_to_completion(burst=4)
+        ok, _ = health.run("readiness")
+        assert ok
+
+    def test_step_fns_ride_compile_watch(self):
+        from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+        reg = MetricRegistry()
+        health = HealthRegistry()
+        cw = CompileWatch(registry=reg, tracer=Tracer())
+
+        def run():
+            cb = ContinuousBatcher(_lm(), max_batch=2, num_pages=32,
+                                   page_size=4, max_new_tokens=6,
+                                   max_burst=4, registry=reg,
+                                   health=health, watch=cw)
+            rs = np.random.RandomState(1)
+            for i, n in enumerate((3, 7, 5)):
+                cb.submit(i, list(rs.randint(1, V + 1, size=(n,))))
+            cb.run_to_completion(burst=4)
+            return cb
+
+        cb = run()
+        assert cb._watch is cw
+        decode = reg.get("compile_watch_compiles_total") \
+                    .value(name="serving_decode")
+        prefill = reg.get("compile_watch_compiles_total") \
+                     .value(name="serving_prefill")
+        assert decode >= 1 and prefill >= 1
+        # same shapes again through the SAME ledger: zero new compiles
+        # — this is the stability a recompile storm would break
+        run()
+        assert reg.get("compile_watch_compiles_total") \
+                  .value(name="serving_decode") == decode
+        assert reg.get("compile_watch_compiles_total") \
+                  .value(name="serving_prefill") == prefill
