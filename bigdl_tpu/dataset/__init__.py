@@ -7,3 +7,5 @@ from bigdl_tpu.dataset.transformer import (Transformer, ChainedTransformer,
 from bigdl_tpu.dataset.dataset import (AbstractDataSet, LocalArrayDataSet,
                                        ShardedDataSet, DataSet, array,
                                        iterator_source)
+from bigdl_tpu.dataset.prefetch import (PrefetchIterator, DevicePrefetcher,
+                                        PadPartialBatches)

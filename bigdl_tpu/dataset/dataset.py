@@ -72,6 +72,17 @@ class AbstractDataSet:
     def set_position_state(self, state, mid_pass: bool = False) -> None:
         pass
 
+    def advance_position_state(self, state):
+        """Return ``state`` as it reads after one training pass has
+        STARTED from it. The prefetch-era checkpoint path
+        (dataset/prefetch.py): the worker's read-ahead may already have
+        crossed into the next pass, so the optimizers checkpoint the
+        epoch-start snapshot advanced by the CONSUMER's progress instead
+        of the live (worker-polluted) state — unconsumed prefetched
+        batches fold back into the saved position. Default: position
+        state carries no per-pass component, return it unchanged."""
+        return state
+
     def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
         return self.transform(transformer)
 
@@ -104,6 +115,9 @@ class TransformedDataSet(AbstractDataSet):
 
     def set_position_state(self, state, mid_pass: bool = False):
         self.base.set_position_state(state, mid_pass)
+
+    def advance_position_state(self, state):
+        return self.base.advance_position_state(state)
 
     def local_size(self):
         base_local = getattr(self.base, "local_size", self.base.size)
@@ -187,6 +201,18 @@ class PassRotationMixin:
         # training iterator must replay that same pass (the optimizer then
         # fast-forwards past the consumed batches)
         self._pass_count = passes - 1 if (mid_pass and passes > 0) else passes
+
+    def advance_position_state(self, state):
+        """One consumer pass started from ``state``: passes_started + 1.
+        Within one epoch exactly one pass starts (the boundary crossing
+        into the NEXT pass happens only on the epoch's final batch,
+        after which the optimizers re-snapshot), so the epoch-start
+        snapshot advanced once equals what the synchronous loop's live
+        read would have said mid-epoch — read-ahead folded back."""
+        out = dict(state)
+        out["passes_started"] = \
+            int(np.asarray(state.get("passes_started", 0))) + 1
+        return out
 
 
 class ShardedDataSet(PassRotationMixin, AbstractDataSet):

@@ -1,0 +1,422 @@
+"""Overlapped input pipeline: threaded prefetch + early device placement.
+
+The reference hid host input cost behind per-executor cached RDD
+partitions (BigDL, arXiv:1804.05839) and BigDL 2.0 made pipeline-stage
+overlap a headline feature (arXiv:2204.01715). The TPU-native rendering:
+the async-dispatch train loop (docs/PERFORMANCE.md) already keeps
+``max_in_flight`` device steps in the air; this module moves the host
+side of the NEXT batch — ``next(data_iter)`` + transforms +
+``to_jax_batch`` + sharded placement — off the critical path and onto a
+worker thread, so the loop's ``host input`` phase collapses to a queue
+pop (the ``input wait`` span).
+
+Pieces:
+
+- :class:`PrefetchIterator` — bounded-queue, daemon-worker prefetch
+  over any MiniBatch iterator. Exceptions raised by the source or the
+  stage propagate to the consumer; :meth:`close` joins the worker.
+- :class:`DevicePrefetcher` — the placement stage: ``device_put`` /
+  ``jax.make_array_from_process_local_data`` in the worker, so batches
+  arrive in HBM before the loop ever sees them. Also callable on an
+  iterator (the historic ``recordio.DevicePrefetcher`` dispatch-ahead
+  form, kept for user pipelines).
+- :class:`PadPartialBatches` — host-side stage padding the final
+  partial batch of a pass to the full batch shape, carrying the real
+  row count in ``MiniBatch.valid`` so the train step can mask the
+  padding out of the loss (``nn.MaskedCriterion``) — one compiled
+  signature per step name instead of one per distinct batch shape.
+
+EXACT CHECKPOINT/REPLAY SEMANTICS. The shipped datasets checkpoint
+(permutation, passes_started) — never an intra-pass offset — and the
+optimizers replay a mid-epoch resume by fast-forwarding the consumed
+batch count under the epoch-start host-RNG snapshot. Prefetch preserves
+that contract because the worker is EPOCH-BOUNDED: ``max_records``
+stops it at exactly the batch where the consumer's epoch ends, so the
+worker performs precisely the pull sequence (and host-RNG draws) the
+synchronous loop would have — read-ahead never leaks into the next
+pass, and unconsumed prefetched batches are simply dropped on resume
+and re-produced by the replay. Equivalently: everything the worker ran
+ahead on is folded back into the (position state, consumed-batch
+count) pair the checkpoint already carries.
+
+THREAD-SAFETY CONTRACT. ``shuffle()`` / ``set_position_state()`` on the
+source dataset may NOT race the prefetch worker — both mutate the
+order the worker is iterating. The optimizers therefore ``close()``
+(drain + join) the pipeline BEFORE the epoch-boundary ``shuffle()`` and
+build a fresh one after; wrapping a dataset that still has a live
+worker raises (``_LIVE_SOURCES`` guard). tests/test_prefetch.py
+stress-tests the handoff (many epochs, depth-1 queue).
+
+HOST-ONLY CONTRACT: no module-level jax import (jaxlint JX5 pins this
+file) — the queue/thread machinery must be importable and testable with
+no device runtime; jax is lazily imported only inside the sanctioned
+placement calls.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import weakref
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.observability import trace
+from bigdl_tpu.observability.registry import default_registry
+
+__all__ = ["PrefetchIterator", "DevicePrefetcher", "PadPartialBatches",
+           "open_input_pipeline"]
+
+_DONE = object()
+
+
+def _is_device_array(x) -> bool:
+    """jax.Array check without importing jax (host-only contract): the
+    module path is enough, and a host batch is never a jax type."""
+    return type(x).__module__.split(".")[0] in ("jax", "jaxlib")
+
+
+class _Raised:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+# datasets with a live prefetch worker (enforces the thread-safety
+# contract above: one worker per source, close() before re-wrapping)
+_LIVE_SOURCES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class PrefetchIterator:
+    """Bounded-queue threaded prefetch over a MiniBatch iterator.
+
+    A daemon worker pulls from ``source``, applies ``stage`` (e.g.
+    :class:`DevicePrefetcher`), and enqueues up to ``depth`` finished
+    batches. The consumer's ``next()`` is a queue pop; when the queue
+    is empty with the worker still producing, the pop is counted as
+    ``input_starvation_total`` and marked with an ``input starvation``
+    trace instant — the signal that ``depth`` (or the host) is too
+    small for the step time. Queue occupancy is exported as the
+    ``prefetch_queue_depth`` gauge.
+
+    ``max_records`` bounds the worker to one epoch of the consumer's
+    accounting: it stops (without closing the source) right after the
+    batch whose cumulative ``shape[0] * records_scale`` reaches the
+    bound — exactly where the training loop declares epoch end. A
+    finite source simply ends the stream (StopIteration propagates).
+
+    Exceptions from source/stage re-raise in the consumer; ``close()``
+    is idempotent, drains the queue, and joins the worker (raising if
+    it refuses to die — a deadlock should be loud, not silent).
+    """
+
+    def __init__(self, source, *, depth: int = 2, stage=None,
+                 max_records: int | None = None, records_scale: int = 1,
+                 name: str = "input", dataset=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if dataset is not None:
+            if dataset in _LIVE_SOURCES:
+                raise RuntimeError(
+                    "dataset already has a live prefetch worker — close() "
+                    "the previous PrefetchIterator before shuffle()/"
+                    "set_position_state()/re-wrapping (thread-safety "
+                    "contract, dataset/prefetch.py)")
+            _LIVE_SOURCES.add(dataset)
+        self._source = source
+        self._stage = stage
+        self._depth = depth
+        self._max_records = max_records
+        self._scale = max(1, int(records_scale))
+        self._name = name
+        self._dataset = dataset
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._closed = False
+        reg = default_registry()
+        self._gauge = reg.gauge(
+            "prefetch_queue_depth",
+            "batches ready in the prefetch queue", labelnames=("pipeline",))
+        self._starved = reg.counter(
+            "input_starvation_total",
+            "consumer blocked on an empty prefetch queue",
+            labelnames=("pipeline",))
+        # the worker continues the CREATOR's host-RNG stream: transforms
+        # drawing augmentation randomness must land exactly where the
+        # synchronous loop's draws would (bit-identical contract). The
+        # creator thread must not draw from it while the worker runs —
+        # the optimizers only touch host RNG (shuffle, snapshots) with
+        # the pipeline closed, per the epoch-boundary handoff.
+        from bigdl_tpu.utils.random import RandomGenerator
+        self._host_rng = RandomGenerator.RNG()
+        self._worker = threading.Thread(
+            target=self._work, name=f"prefetch:{name}", daemon=True)
+        self._worker.start()
+
+    # -- worker side --
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to close(); returns False
+        when the pipeline was closed underneath us."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self):
+        from bigdl_tpu.utils.random import RandomGenerator
+        RandomGenerator.adopt(self._host_rng)
+        pulled = 0
+        try:
+            while not self._stop.is_set():
+                if self._max_records is not None and \
+                        pulled * self._scale >= self._max_records:
+                    break  # epoch bound: the consumer ends here too
+                try:
+                    with trace.span("input produce", pipeline=self._name):
+                        b = next(self._source)
+                        n = b.size() if isinstance(b, MiniBatch) \
+                            else int(np.asarray(
+                                getattr(b, "data", b)).shape[0])
+                        if self._stage is not None:
+                            b = self._stage(b)
+                except StopIteration:
+                    break
+                pulled += n
+                if not self._put(b):
+                    return
+            self._put(_DONE)
+        except BaseException as e:  # propagate into the consumer
+            self._put(_Raised(e))
+
+    # -- consumer side --
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._q.empty() and self._worker.is_alive():
+            self._starved.inc(pipeline=self._name)
+            trace.instant("input starvation", pipeline=self._name)
+        item = self._q.get()
+        self._gauge.set(self._q.qsize(), pipeline=self._name)
+        if item is _DONE:
+            self._finish()
+            raise StopIteration
+        if isinstance(item, _Raised):
+            self._finish()
+            raise item.exc
+        return item
+
+    def _finish(self):
+        self._done = True
+        self._worker.join(timeout=10.0)
+        self._release()
+
+    def _release(self):
+        if self._dataset is not None:
+            _LIVE_SOURCES.discard(self._dataset)
+            self._dataset = None
+
+    @property
+    def running(self) -> bool:
+        return self._worker.is_alive()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the worker and join it. Idempotent; safe mid-stream
+        (unconsumed batches are dropped — replay re-produces them,
+        see the module docstring)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._done = True
+        self._stop.set()
+        deadline = timeout
+        while self._worker.is_alive() and deadline > 0:
+            try:  # unblock a worker stuck in put()
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._worker.join(timeout=0.1)
+            deadline -= 0.1
+        self._release()
+        if self._worker.is_alive():
+            raise RuntimeError(
+                f"prefetch worker '{self._name}' did not stop within "
+                f"{timeout}s — source iterator is wedged")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _SyncPipeline:
+    """depth=0 path: the same stage composition run inline, same
+    interface (``input produce`` span included so depth-0 and depth-2
+    traces stay comparable)."""
+
+    def __init__(self, source, stage=None, name: str = "input"):
+        self._source = source
+        self._stage = stage
+        self._name = name
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with trace.span("input produce", pipeline=self._name):
+            b = next(self._source)
+            if self._stage is not None:
+                b = self._stage(b)
+            return b
+
+    @property
+    def running(self) -> bool:
+        return False
+
+    def close(self, timeout: float = 0.0) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def open_input_pipeline(source, *, depth: int, stage=None,
+                        max_records: int | None = None,
+                        records_scale: int = 1, name: str = "input",
+                        dataset=None):
+    """Factory the optimizers use: ``depth == 0`` is today's synchronous
+    path (stages run inline on the consumer thread), ``depth >= 1``
+    overlaps them on a prefetch worker."""
+    if depth <= 0:
+        return _SyncPipeline(source, stage, name=name)
+    return PrefetchIterator(source, depth=depth, stage=stage,
+                            max_records=max_records,
+                            records_scale=records_scale, name=name,
+                            dataset=dataset)
+
+
+class PadPartialBatches:
+    """Pad partial batches up to the largest batch shape seen.
+
+    A pre-batched source (``DataSet.iterator``) ends each pass with a
+    short batch; every distinct shape costs the train step a fresh XLA
+    compile (``compile_watch`` counts them). This stage edge-repeats the
+    last row of data AND labels up to the full batch size and records
+    the real row count in ``MiniBatch.valid`` — the optimizers turn that
+    into an in-step validity mask (``nn.MaskedCriterion``) so padded
+    rows contribute exactly zero to loss and gradient.
+
+    Stateful across passes: ``full_size`` is learned from the largest
+    batch seen (checkpoints carry it so a resume that starts on the
+    partial batch still pads to the original shape). Host batches only —
+    padding an already-placed device batch would mean a readback, so
+    that is refused loudly.
+    """
+
+    def __init__(self, full_size: int | None = None):
+        self.full_size = int(full_size or 0)
+
+    def __call__(self, b: MiniBatch) -> MiniBatch:
+        if _is_device_array(b.data):
+            raise ValueError(
+                "pad_partial_batches needs host batches, but the dataset "
+                "yields already-placed device arrays — drop the "
+                "dataset-level DevicePrefetcher (the optimizer's input "
+                "pipeline places batches itself)")
+        data = np.asarray(b.data)
+        labels = np.asarray(b.labels)
+        n = int(data.shape[0])
+        if n >= self.full_size:
+            self.full_size = n
+            return MiniBatch(data, labels, valid=n)
+        pad = self.full_size - n
+        # edge-repeat keeps padded rows valid inputs (a zero-filled
+        # label would be out of range for 1-based class targets); the
+        # mask guarantees they still contribute nothing
+        data = np.concatenate([data, np.repeat(data[-1:], pad, axis=0)])
+        labels = np.concatenate(
+            [labels, np.repeat(labels[-1:], pad, axis=0)])
+        return MiniBatch(data, labels, valid=n)
+
+
+class DevicePrefetcher:
+    """Early device placement (moved here from ``dataset.recordio``).
+
+    Stage form (:meth:`place_batch` / passing the instance as a
+    ``PrefetchIterator`` stage): ``device_put`` — or, multi-host,
+    ``jax.make_array_from_process_local_data`` over ``sharding`` — runs
+    on the prefetch worker, so the train loop dequeues batches that are
+    already in HBM (the final stage of the reference's decode-ahead
+    pipeline, MTLabeledBGRImgToBatch.scala:46-103, reborn as an
+    input-pipeline stage feeding HBM).
+
+    Iterator form (``DevicePrefetcher(sharding)(it)``) keeps the
+    historic dispatch-ahead generator for user-built dataset pipelines:
+    placement of ``depth`` batches is issued ahead of consumption on
+    the calling thread (no worker).
+    """
+
+    def __init__(self, sharding=None, depth: int = 2,
+                 label_sharding=None):
+        self.sharding = sharding
+        self.label_sharding = label_sharding
+        self.depth = depth
+
+    def _place(self, arr, sharding):
+        import jax
+        if sharding is None:
+            return jax.device_put(arr)
+        if jax.process_count() > 1:
+            # mesh spans non-addressable devices: assemble the global
+            # array from this process's local batch, exactly like
+            # DistriOptimizer._shard_batch's multi-host branch
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
+
+    def place_batch(self, b: MiniBatch) -> MiniBatch:
+        import jax
+        if isinstance(b.data, jax.Array):
+            return b  # a user pipeline already placed it upstream
+        data = np.asarray(b.data)
+        if self.sharding is not None:
+            # raise the friendly misconfiguration error BEFORE
+            # device_put/make_array produce a low-level sharding error
+            # (the consumer's check can't fire: placement happens here)
+            n_dev = len(self.sharding.device_set)
+            global_n = data.shape[0] * (jax.process_count()
+                                        if jax.process_count() > 1 else 1)
+            if global_n % n_dev != 0:
+                raise ValueError(
+                    f"global batch {global_n} not divisible by {n_dev} "
+                    "mesh devices (reference Utils.getBatchSize "
+                    "divisibility requirement, dataset/Utils.scala:25-47)")
+        labels = np.asarray(b.labels)
+        label_sharding = self.label_sharding
+        if label_sharding is None:
+            label_sharding = self.sharding
+        return MiniBatch(self._place(data, self.sharding),
+                         self._place(labels, label_sharding),
+                         valid=b.valid)
+
+    def __call__(self, it):
+        from collections import deque
+        queue_: deque = deque()
+        for batch in it:
+            queue_.append(self.place_batch(batch))
+            if len(queue_) > self.depth:
+                yield queue_.popleft()
+        while queue_:
+            yield queue_.popleft()

@@ -30,6 +30,9 @@ from typing import Iterator
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet, PassRotationMixin
+# DevicePrefetcher moved to dataset/prefetch.py (ISSUE 5 input-pipeline
+# subsystem); re-exported here for existing call sites
+from bigdl_tpu.dataset.prefetch import DevicePrefetcher  # noqa: F401
 from bigdl_tpu.dataset.sample import ByteRecord
 from bigdl_tpu.utils.random import RandomGenerator
 
@@ -254,56 +257,3 @@ class RecordShardDataSet(PassRotationMixin, AbstractDataSet):
             for i in self._index:
                 yield from read_records(self._local[int(i)])
         return single()
-
-
-class DevicePrefetcher:
-    """Wrap a MiniBatch iterator; device_put batches ``depth`` ahead so
-    host->device transfer overlaps the device step (the final stage of the
-    reference's decode-ahead pipeline, MTLabeledBGRImgToBatch.scala:46-103,
-    reborn as an input-pipeline stage feeding HBM)."""
-
-    def __init__(self, sharding=None, depth: int = 2):
-        self.sharding = sharding
-        self.depth = depth
-
-    def __call__(self, it):
-        import jax
-        from collections import deque
-        from bigdl_tpu.dataset.sample import MiniBatch
-
-        multi = jax.process_count() > 1
-
-        def place(arr):
-            if self.sharding is None:
-                return jax.device_put(arr)
-            if multi:
-                # mesh spans non-addressable devices: assemble the global
-                # array from this process's local batch, exactly like
-                # DistriOptimizer._shard_batch's multi-host branch
-                return jax.make_array_from_process_local_data(
-                    self.sharding, arr)
-            return jax.device_put(arr, self.sharding)
-
-        def put(b):
-            data = np.asarray(b.data)
-            if self.sharding is not None:
-                # raise the friendly misconfiguration error BEFORE
-                # device_put/make_array produce a low-level sharding error
-                # (the consumer's check can't fire: placement happens here)
-                n_dev = len(self.sharding.device_set)
-                global_n = data.shape[0] * (jax.process_count() if multi
-                                            else 1)
-                if global_n % n_dev != 0:
-                    raise ValueError(
-                        f"global batch {global_n} not divisible by {n_dev} "
-                        "mesh devices (reference Utils.getBatchSize "
-                        "divisibility requirement, dataset/Utils.scala:25-47)")
-            return MiniBatch(place(data), place(np.asarray(b.labels)))
-
-        queue: deque = deque()
-        for batch in it:
-            queue.append(put(batch))
-            if len(queue) > self.depth:
-                yield queue.popleft()
-        while queue:
-            yield queue.popleft()

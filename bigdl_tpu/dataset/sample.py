@@ -36,15 +36,26 @@ class Sample:
 
 
 class MiniBatch:
-    """One training batch (reference dataset/Types.scala:73)."""
+    """One training batch (reference dataset/Types.scala:73).
 
-    __slots__ = ("data", "labels")
+    ``valid``: number of REAL rows when the batch was padded to a fixed
+    shape (``dataset.prefetch.PadPartialBatches``); None means every row
+    is real. Carried as a host int so record accounting never has to
+    read a device array back."""
 
-    def __init__(self, data, labels):
+    __slots__ = ("data", "labels", "valid")
+
+    def __init__(self, data, labels, valid=None):
         self.data = data
         self.labels = labels
+        self.valid = valid
 
     def size(self) -> int:
+        # shape attribute first: np and jax arrays both carry it, and
+        # np.asarray on a device array would force a host transfer
+        shape = getattr(self.data, "shape", None)
+        if shape is not None:
+            return int(shape[0])
         return int(np.asarray(self.data).shape[0])
 
     def narrow(self, offset: int, length: int) -> "MiniBatch":

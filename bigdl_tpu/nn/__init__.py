@@ -43,6 +43,6 @@ from bigdl_tpu.nn.criterion import (
     MultiCriterion, MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
     MultiMarginCriterion, SmoothL1Criterion, SmoothL1CriterionWithWeights,
     SoftMarginCriterion, SoftmaxWithCriterion, ParallelCriterion,
-    TimeDistributedCriterion, CriterionTable)
+    TimeDistributedCriterion, CriterionTable, MaskedCriterion)
 from bigdl_tpu.nn.detection import Nms, nms
 from bigdl_tpu.nn import init  # noqa: F401

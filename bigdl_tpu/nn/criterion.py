@@ -30,7 +30,7 @@ __all__ = ["ClassNLLCriterion", "MSECriterion", "BCECriterion",
            "MultiMarginCriterion", "SmoothL1Criterion",
            "SmoothL1CriterionWithWeights", "SoftMarginCriterion",
            "SoftmaxWithCriterion", "ParallelCriterion",
-           "TimeDistributedCriterion", "CriterionTable"]
+           "TimeDistributedCriterion", "CriterionTable", "MaskedCriterion"]
 
 
 def _avg(v, n, size_average):
@@ -501,3 +501,32 @@ class CriterionTable(Criterion):
     def apply(self, x, target=None):
         inp, t = x
         return self.critrn.apply(inp, t)
+
+
+class MaskedCriterion(Criterion):
+    """Row-validity mask around any per-sample-decomposable criterion.
+
+    The input-pipeline's partial-batch padding
+    (``dataset.prefetch.PadPartialBatches``) keeps the train step at ONE
+    compiled signature by padding short batches to the full shape; this
+    wrapper guarantees the padded rows contribute exactly zero to the
+    loss AND its gradient: the base criterion is vmapped over the batch
+    axis (each row evaluated as its own batch of one — valid for any
+    criterion whose batch loss is a mean/sum of per-row terms), the
+    per-row losses are multiplied by ``mask``, and the reduction honors
+    the base's ``size_average`` (mean over VALID rows, or masked sum).
+    """
+
+    def __init__(self, criterion: Criterion):
+        super().__init__()
+        self.criterion = criterion
+
+    def apply(self, x, target, mask):
+        per_row = jax.vmap(
+            lambda xi, ti: self.criterion.apply(xi[None], ti[None]))(
+                x, target)
+        m = mask.astype(per_row.dtype)
+        total = jnp.sum(per_row * m)
+        if getattr(self.criterion, "size_average", True):
+            return total / jnp.maximum(jnp.sum(m), 1.0)
+        return total

@@ -192,13 +192,26 @@ class DistriOptimizer(Optimizer):
         mstate = jax.device_put(mstate, repl)
         opt_state = jax.device_put(opt_state, opt_shard)
 
-        def train_step(params, mstate, opt_state, rng, data, labels, epoch):
+        use_mask = self._pad_stage is not None
+        if use_mask:
+            from bigdl_tpu.nn.criterion import MaskedCriterion
+            masked = MaskedCriterion(criterion)
+
+        def train_step(params, mstate, opt_state, rng, data, labels, epoch,
+                       n_valid=None):
             if self.input_transform is not None:
                 data = self.input_transform(data)
 
             def loss_fn(p):
                 y, new_mstate = model.apply(p, mstate, data, training=True,
                                             rng=rng)
+                if use_mask:
+                    # validity mask from the real row count: padded rows
+                    # contribute exactly zero to loss and the gradient
+                    # allreduce (nn.MaskedCriterion); XLA shards the
+                    # iota like the batch
+                    mask = jnp.arange(data.shape[0]) < n_valid
+                    return masked.apply(y, labels, mask), new_mstate
                 # mean over the GLOBAL batch — the gradient allreduce this
                 # induces in backward IS the reference's whole
                 # parameters/AllReduceParameter machinery
@@ -212,13 +225,16 @@ class DistriOptimizer(Optimizer):
                                                      opt_state)
             return new_params, new_mstate, new_opt_state, loss
 
+        # label_shard is None under sequence_parallel (rank-derived at
+        # placement, _shard_batch); jit then inherits the arg sharding
+        in_shardings = (param_shard, repl, opt_shard, repl, batch_shard,
+                        label_shard, None)
+        if use_mask:
+            in_shardings += (None,)   # n_valid: replicated scalar
         jit_step = jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
-            # label_shard is None under sequence_parallel (rank-derived at
-            # placement, _shard_batch); jit then inherits the arg sharding
-            in_shardings=(param_shard, repl, opt_shard, repl, batch_shard,
-                          label_shard, None),
+            in_shardings=in_shardings,
             out_shardings=(param_shard, repl, opt_shard, repl))
         compiled_steps = {}    # batch shape -> AOT executable (partial
                                # final batches recompile, like jit would);
@@ -249,124 +265,151 @@ class DistriOptimizer(Optimizer):
             # ZeRO-sharded) — only the batch is padded/placed/trimmed
             eval_fn = _padded_eval(jit_eval, batch_shard, n_shards)
 
+        def place(batch):
+            """Host batch -> mesh-sharded device batch, run on the
+            prefetch worker (depth >= 1) so placement overlaps the
+            in-flight device steps; also the depth-0 inline stage."""
+            if isinstance(batch.data, jax.Array):
+                # a user-pipeline DevicePrefetcher already placed it
+                # (overlapped upstream) — don't round-trip it, but keep
+                # the friendly divisibility error for sharding-less
+                # prefetchers and user-placed arrays
+                if batch.data.shape[0] % batch_div != 0:
+                    raise ValueError(
+                        f"global batch {batch.data.shape[0]} not "
+                        f"divisible by the {batch_div} data-axis shards "
+                        "(reference Utils.getBatchSize divisibility "
+                        "requirement, dataset/Utils.scala:25-47)")
+                return batch
+            data = np.asarray(batch.data)
+            labels = np.asarray(batch.labels)
+            global_n = data.shape[0] * jax.process_count()
+            if global_n % batch_div != 0:
+                raise ValueError(
+                    f"global batch {global_n} not divisible by the "
+                    f"{batch_div} data-axis shards (reference "
+                    "Utils.getBatchSize divisibility requirement, "
+                    "dataset/Utils.scala:25-47)")
+            if sp_size > 1 and data.shape[1] % sp_size != 0:
+                raise ValueError(
+                    f"sequence length {data.shape[1]} not divisible "
+                    f"by the {sp_size}-way '{sp_axis}' mesh axis "
+                    "(sequence_parallel shards batch dim 1)")
+            data, labels = self._shard_batch(data, labels, batch_shard,
+                                             label_shard)
+            from bigdl_tpu.dataset.sample import MiniBatch
+            return MiniBatch(data, labels, valid=batch.valid)
+
         epoch_start_host_rng = self._host_rng_snapshot()
-        data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         batches_this_epoch = batches_to_skip
-        for _ in range(batches_to_skip):   # fast-forward to the stop point
-            next(data_iter)
+        pipeline = self._open_train_pipeline(
+            place, skip=batches_to_skip, consumed=count_this_epoch,
+            records_scale=jax.process_count())
         window, lockstep = self._dispatch_window()
         pending: list[dict] = []
         wallclock_start = time.perf_counter()
 
-        while self.end_when is None or not self.end_when(driver_state):
-            driver_state["is_epoch_end"] = False
-            self._profile_hook(driver_state["neval"])
-            t0 = time.perf_counter()
-            with trace.span("host input"):
-                batch = next(data_iter)
-                if isinstance(batch.data, jax.Array):
-                    # DevicePrefetcher already placed the batch
-                    # (overlapped with the previous device step) —
-                    # don't round-trip it
-                    data, labels = batch.data, batch.labels
-                    global_n = data.shape[0]
-                    needs_shard = False
+        try:
+            while self.end_when is None or not self.end_when(driver_state):
+                driver_state["is_epoch_end"] = False
+                self._profile_hook(driver_state["neval"])
+                t0 = time.perf_counter()
+                with trace.span("input wait"):
+                    # queue pop at depth >= 1: the batch was assembled,
+                    # checked, and mesh-placed on the worker thread
+                    # ("input produce")
+                    batch = next(pipeline)
+                t1 = time.perf_counter()
+                data_time = t1 - t0
+                data, labels = batch.data, batch.labels
+                if batch.valid is not None:
+                    # padded batch: count the REAL rows (single
+                    # controller — _init_pad_stage refuses multi-host)
+                    global_n = int(batch.valid)
                 else:
-                    data = np.asarray(batch.data)
-                    labels = np.asarray(batch.labels)
-                    global_n = data.shape[0] * jax.process_count()
-                    needs_shard = True
-                if global_n % batch_div != 0:
-                    # a mesh-sharded DevicePrefetcher raised this before
-                    # placement; this covers host batches, sharding-less
-                    # prefetchers, and user-placed arrays
-                    raise ValueError(
-                        f"global batch {global_n} not divisible by the "
-                        f"{batch_div} data-axis shards (reference "
-                        "Utils.getBatchSize divisibility requirement, "
-                        "dataset/Utils.scala:25-47)")
-                if sp_size > 1 and data.shape[1] % sp_size != 0:
-                    raise ValueError(
-                        f"sequence length {data.shape[1]} not divisible "
-                        f"by the {sp_size}-way '{sp_axis}' mesh axis "
-                        "(sequence_parallel shards batch dim 1)")
-                if needs_shard:
-                    data, labels = self._shard_batch(data, labels,
-                                                     batch_shard,
-                                                     label_shard)
-            t1 = time.perf_counter()
-            data_time = t1 - t0
-            rng, step_rng = jax.random.split(rng)
-            epoch_arr = jnp.asarray(driver_state["epoch"], jnp.int32)
-            shape_key = (data.shape, labels.shape)
-            compiled_this_iter = shape_key not in compiled_steps
-            if compiled_this_iter:
-                with trace.span("compile step",
-                                shape=str(shape_key)):
-                    compiled = jit_step.lower(
-                        params, mstate, opt_state, step_rng, data,
-                        labels, epoch_arr).compile()
-                if not compiled_steps:
-                    self._account_collectives(compiled, n_shards)
-                compiled_steps[shape_key] = compiled
-                # XLA compile/memory telemetry straight off the AOT
-                # executable — compile count, FLOPs, peak HBM land in
-                # the registry (observability/compile_watch.py)
-                compile_watch.note_compile("distri_train_step",
-                                           shape_key, compiled)
-            with trace.span("device step"):
-                # dispatch only — loss stays on device; the packed
-                # readback happens at drain time (docs/PERFORMANCE.md).
-                # Honest phase metrics: the reference's get-weights/
-                # compute/aggregate phases fuse inside the jitted step,
-                # so what's measurable is host input vs device step
-                # (see metrics.py)
-                params, mstate, opt_state, loss = \
-                    compiled_steps[shape_key](
-                        params, mstate, opt_state, step_rng, data,
-                        labels, epoch_arr)
-            t2 = time.perf_counter()
-            self._telemetry_step()
-            n = global_n  # records consumed across all hosts this step
-            count_this_epoch += n
-            batches_this_epoch += 1
-            pending.append({"epoch": driver_state["epoch"],
-                            "count": count_this_epoch,
-                            "epoch_size": epoch_size,
-                            "neval": driver_state["neval"],
-                            "wallclock": time.perf_counter()
-                            - wallclock_start,
-                            "loss": loss, "n": n,
-                            "step_time": t2 - t0, "data_time": data_time,
-                            "device_time": t2 - t1,
-                            "compiled": compiled_this_iter})
-            if len(pending) >= window:
-                self._drain_pending(pending, driver_state,
-                                    lockstep or "window full")
-            driver_state["neval"] += 1
-            if count_this_epoch >= epoch_size:
-                self._drain_pending(pending, driver_state, "epoch end")
-                driver_state["epoch"] += 1
-                driver_state["is_epoch_end"] = True
-                count_this_epoch = 0
-                batches_this_epoch = 0
-                self.dataset.shuffle()
-                epoch_start_host_rng = self._host_rng_snapshot()
-                data_iter = self.dataset.data(train=True)
-            fire_val, fire_ckpt = self._fires(driver_state)
-            if fire_val or fire_ckpt:
-                # validation/checkpoint read host-visible state: flush
-                # the window first, then publish params (host-side tree
-                # walk is overhead on deep models)
-                self._drain_pending(pending, driver_state,
-                                    "validation/checkpoint trigger")
-                model.sync(params, mstate)
-            self._validate(eval_fn, params, mstate, driver_state,
-                           fire=fire_val)
-            self._checkpoint(driver_state, opt_state, rng,
-                             count_this_epoch, batches_this_epoch,
-                             epoch_start_host_rng, fire=fire_ckpt)
+                    global_n = int(data.shape[0])
+                rng, step_rng = jax.random.split(rng)
+                epoch_arr = jnp.asarray(driver_state["epoch"], jnp.int32)
+                step_args = (step_rng, data, labels, epoch_arr)
+                if use_mask:
+                    step_args += (jnp.asarray(global_n, jnp.int32),)
+                shape_key = (data.shape, labels.shape)
+                compiled_this_iter = shape_key not in compiled_steps
+                if compiled_this_iter:
+                    with trace.span("compile step",
+                                    shape=str(shape_key)):
+                        compiled = jit_step.lower(
+                            params, mstate, opt_state,
+                            *step_args).compile()
+                    if not compiled_steps:
+                        self._account_collectives(compiled, n_shards)
+                    compiled_steps[shape_key] = compiled
+                    # XLA compile/memory telemetry straight off the AOT
+                    # executable — compile count, FLOPs, peak HBM land in
+                    # the registry (observability/compile_watch.py)
+                    compile_watch.note_compile("distri_train_step",
+                                               shape_key, compiled)
+                with trace.span("device step"):
+                    # dispatch only — loss stays on device; the packed
+                    # readback happens at drain time (docs/PERFORMANCE.md).
+                    # Honest phase metrics: the reference's get-weights/
+                    # compute/aggregate phases fuse inside the jitted
+                    # step, so what's measurable is input wait vs device
+                    # step (see metrics.py)
+                    params, mstate, opt_state, loss = \
+                        compiled_steps[shape_key](
+                            params, mstate, opt_state, *step_args)
+                t2 = time.perf_counter()
+                self._telemetry_step()
+                n = global_n  # records consumed across all hosts
+                count_this_epoch += n
+                batches_this_epoch += 1
+                pending.append({"epoch": driver_state["epoch"],
+                                "count": count_this_epoch,
+                                "epoch_size": epoch_size,
+                                "neval": driver_state["neval"],
+                                "wallclock": time.perf_counter()
+                                - wallclock_start,
+                                "loss": loss, "n": n,
+                                "step_time": t2 - t0,
+                                "data_time": data_time,
+                                "device_time": t2 - t1,
+                                "compiled": compiled_this_iter})
+                if len(pending) >= window:
+                    self._drain_pending(pending, driver_state,
+                                        lockstep or "window full")
+                driver_state["neval"] += 1
+                if count_this_epoch >= epoch_size:
+                    self._drain_pending(pending, driver_state, "epoch end")
+                    driver_state["epoch"] += 1
+                    driver_state["is_epoch_end"] = True
+                    count_this_epoch = 0
+                    batches_this_epoch = 0
+                    # join the worker BEFORE shuffle() mutates the order
+                    # it iterates (thread-safety contract,
+                    # dataset/prefetch.py), then restart on the fresh
+                    # epoch's iterator
+                    pipeline.close()
+                    self.dataset.shuffle()
+                    epoch_start_host_rng = self._host_rng_snapshot()
+                    pipeline = self._open_train_pipeline(
+                        place, records_scale=jax.process_count())
+                fire_val, fire_ckpt = self._fires(driver_state)
+                if fire_val or fire_ckpt:
+                    # validation/checkpoint read host-visible state: flush
+                    # the window first, then publish params (host-side
+                    # tree walk is overhead on deep models)
+                    self._drain_pending(pending, driver_state,
+                                        "validation/checkpoint trigger")
+                    model.sync(params, mstate)
+                self._validate(eval_fn, params, mstate, driver_state,
+                               fire=fire_val)
+                self._checkpoint(driver_state, opt_state, rng,
+                                 count_this_epoch, batches_this_epoch,
+                                 epoch_start_host_rng, fire=fire_ckpt)
+        finally:
+            pipeline.close()
 
         self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()

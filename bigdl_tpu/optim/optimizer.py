@@ -133,6 +133,13 @@ class Optimizer:
         # async dispatch: how many steps may be in flight before the loop
         # drains their losses with one packed readback (docs/PERFORMANCE.md)
         self.max_in_flight = 2
+        # overlapped input pipeline (dataset/prefetch.py): batches are
+        # assembled + device-placed on a worker thread, `depth` ahead of
+        # the loop; 0 = the synchronous path (docs/PERFORMANCE.md)
+        self.prefetch_depth = 2
+        self.pad_partial_batches = False
+        self._pad_stage = None
+        self._epoch_position_state = None
         # telemetry plane (docs/OBSERVABILITY.md): the flight recorder's
         # black box is ON by default (steady-state cost: a deque append
         # per warning/span event); the HTTP exporter is opt-in
@@ -231,6 +238,30 @@ class Optimizer:
             raise ValueError(
                 f"max_in_flight must be >= 1, got {max_in_flight}")
         self.max_in_flight = int(max_in_flight)
+        return self
+
+    def set_input_pipeline(self, depth: int = 2, *,
+                           pad_partial_batches: bool | None = None):
+        """Configure the overlapped input pipeline
+        (``dataset/prefetch.py``, docs/PERFORMANCE.md). ``depth`` >= 1
+        runs ``next(batch)`` + transforms + device placement on a
+        prefetch worker, ``depth`` batches ahead of the train loop, so
+        the loop's input phase is a queue pop (the ``input wait``
+        span); ``depth=0`` restores the synchronous path. On by
+        default (depth 2) — trajectories are bit-identical either way
+        (tests/test_prefetch.py pins it).
+
+        ``pad_partial_batches=True`` additionally pads each pass's
+        final short batch to the full batch shape with an in-step
+        validity mask (``nn.MaskedCriterion``): one compiled train-step
+        signature per run instead of one per distinct batch shape, with
+        padded rows contributing exactly zero to loss and gradient.
+        Returns self."""
+        if int(depth) < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self.prefetch_depth = int(depth)
+        if pad_partial_batches is not None:
+            self.pad_partial_batches = bool(pad_partial_batches)
         return self
 
     def set_end_when(self, end_when: Trigger):
@@ -377,15 +408,31 @@ class Optimizer:
         results = [None] * len(self.validation_methods)
         count = 0
         t0 = time.perf_counter()
-        with trace.span("validation", host_sync="per-batch metric eval"):
-            for batch in self.validation_dataset.data(train=False):
-                data, labels = to_jax_batch(batch)
-                out = apply_fn(params, mstate, data)
-                count += data.shape[0]
-                for i, m in enumerate(self.validation_methods):
-                    r = m(out, labels)
-                    results[i] = r if results[i] is None \
-                        else results[i] + r
+        # in-training validation rides the same prefetch machinery as
+        # the train loop: batch assembly (transforms, stacking) overlaps
+        # eval dispatch on a worker thread (dataset/prefetch.py)
+        from bigdl_tpu.dataset.prefetch import open_input_pipeline
+        val_iter = open_input_pipeline(
+            self.validation_dataset.data(train=False),
+            depth=self.prefetch_depth, name="val",
+            # validating ON the training set is legal: the train
+            # pipeline already holds that dataset's worker guard
+            dataset=(self.validation_dataset
+                     if self.validation_dataset is not self.dataset
+                     else None))
+        try:
+            with trace.span("validation",
+                            host_sync="per-batch metric eval"):
+                for batch in val_iter:
+                    data, labels = to_jax_batch(batch)
+                    out = apply_fn(params, mstate, data)
+                    count += data.shape[0]
+                    for i, m in enumerate(self.validation_methods):
+                        r = m(out, labels)
+                        results[i] = r if results[i] is None \
+                            else results[i] + r
+        finally:
+            val_iter.close()
         if jax.process_count() > 1:
             # each process validated its own shard; reduce to the global
             # result on every host (reference DistriValidator's driver
@@ -457,9 +504,23 @@ class Optimizer:
         full_state["host_rng_state"] = (epoch_start_host_rng
                                         if epoch_start_host_rng is not None
                                         else self._host_rng_snapshot())
-        pos = self.dataset.get_position_state()
+        # prefetch-era position state: the worker's read-ahead may have
+        # advanced the LIVE state past the consumer (it can start the
+        # next pass while the loop is still mid-epoch), so the loops
+        # snapshot at pipeline creation and the snapshot is advanced by
+        # the CONSUMER's progress — unconsumed prefetched batches fold
+        # back into the saved position (dataset/prefetch.py)
+        pos = self._epoch_position_state
+        if pos is not None and batches_this_epoch > 0:
+            pos = self.dataset.advance_position_state(pos)
+        if pos is None:
+            pos = self.dataset.get_position_state()
         if pos is not None:
             full_state["data_position"] = pos
+        if self._pad_stage is not None and self._pad_stage.full_size:
+            # the learned full batch shape: a resume whose first replayed
+            # batch is the short one must still pad to the original size
+            full_state["pad_full_size"] = int(self._pad_stage.full_size)
         _file.save(full_state,
                    f"{self.checkpoint_path}/state{suffix}", overwrite=True)
         logger.info(f"Save model to {self.checkpoint_path}/model{suffix}")
@@ -608,7 +669,58 @@ class Optimizer:
         pos = self.state.get("data_position")
         if pos is not None:
             self.dataset.set_position_state(pos, mid_pass=skip > 0)
+        self._init_pad_stage()
         return opt_state, rng, count, skip
+
+    # -- overlapped input pipeline (dataset/prefetch.py) --
+    def _init_pad_stage(self):
+        """Per-run partial-batch pad stage; the checkpoint carries the
+        learned full batch size so a resume whose first replayed batch
+        is the short one still pads to the original shape."""
+        if not self.pad_partial_batches:
+            self._pad_stage = None
+            return
+        if jax.process_count() > 1:
+            raise ValueError(
+                "pad_partial_batches is single-controller only: each "
+                "process pads its own block of the global batch, so the "
+                "in-step validity mask (arange < valid) cannot describe "
+                "the multi-host row layout — pad per-process batches in "
+                "the dataset pipeline instead")
+        from bigdl_tpu.dataset.prefetch import PadPartialBatches
+        saved = int(self.state.get("pad_full_size", 0))
+        self._pad_stage = PadPartialBatches(saved or None)
+
+    def _open_train_pipeline(self, place, *, skip: int = 0,
+                             consumed: int = 0, records_scale: int = 1):
+        """Build one epoch's input pipeline: raw dataset iterator ->
+        optional partial-batch padding -> device placement, overlapped
+        on a prefetch worker at ``prefetch_depth`` >= 1 (synchronous at
+        0). The worker is EPOCH-BOUNDED (``max_records``) so its pull
+        sequence — and therefore every host-RNG draw and pass
+        transition — is exactly the synchronous loop's; the position
+        state is snapshotted here, before the fast-forward pulls, for
+        :meth:`_checkpoint`. MUST be close()d before
+        ``dataset.shuffle()`` (thread-safety contract,
+        dataset/prefetch.py)."""
+        from bigdl_tpu.dataset.prefetch import open_input_pipeline
+        self._epoch_position_state = self.dataset.get_position_state()
+        raw = self.dataset.data(train=True)
+        for _ in range(skip):   # fast-forward to the resume point
+            next(raw)
+        pad = self._pad_stage
+        if pad is not None and place is not None:
+            def stage(b, _pad=pad, _place=place):
+                return _place(_pad(b))
+        else:
+            stage = pad if place is None else place
+        max_records = None
+        if self.prefetch_depth > 0:
+            max_records = max(int(self.dataset.size()) - int(consumed), 0)
+        return open_input_pipeline(raw, depth=self.prefetch_depth,
+                                   stage=stage, max_records=max_records,
+                                   records_scale=records_scale,
+                                   name="train", dataset=self.dataset)
 
 
 class LocalOptimizer(Optimizer):
@@ -629,13 +741,25 @@ class LocalOptimizer(Optimizer):
         opt_state, rng, count_this_epoch, batches_to_skip = \
             self._resume(optim, params)
 
-        def train_step(params, mstate, opt_state, rng, data, labels, epoch):
+        use_mask = self._pad_stage is not None
+        if use_mask:
+            from bigdl_tpu.nn.criterion import MaskedCriterion
+            masked = MaskedCriterion(criterion)
+
+        def train_step(params, mstate, opt_state, rng, data, labels, epoch,
+                       n_valid=None):
             if self.input_transform is not None:
                 data = self.input_transform(data)
 
             def loss_fn(p):
                 y, new_mstate = model.apply(p, mstate, data, training=True,
                                             rng=rng)
+                if use_mask:
+                    # validity mask materialized in-step from the real
+                    # row count: padded rows contribute exactly zero to
+                    # loss and gradient (nn.MaskedCriterion)
+                    mask = jnp.arange(data.shape[0]) < n_valid
+                    return masked.apply(y, labels, mask), new_mstate
                 return criterion.apply(y, labels), new_mstate
 
             (loss, new_mstate), grads = jax.value_and_grad(
@@ -661,72 +785,95 @@ class LocalOptimizer(Optimizer):
 
         jit_eval = jax.jit(eval_apply)
 
+        def place(b):
+            # runs on the prefetch worker (depth >= 1): host->device
+            # transfer overlaps the in-flight device steps
+            if isinstance(b.data, jax.Array):
+                return b   # a user pipeline already placed it
+            from bigdl_tpu.dataset.sample import MiniBatch
+            return MiniBatch(jnp.asarray(b.data), jnp.asarray(b.labels),
+                             valid=b.valid)
+
         epoch_start_host_rng = self._host_rng_snapshot()
-        data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         batches_this_epoch = batches_to_skip
-        for _ in range(batches_to_skip):   # fast-forward to the stop point
-            next(data_iter)
+        pipeline = self._open_train_pipeline(place, skip=batches_to_skip,
+                                             consumed=count_this_epoch)
         window, lockstep = self._dispatch_window()
         pending: list[dict] = []
         wallclock_start = time.perf_counter()
 
-        while self.end_when is None or not self.end_when(driver_state):
-            driver_state["is_epoch_end"] = False
-            self._profile_hook(driver_state["neval"])
-            t0 = time.perf_counter()
-            with trace.span("host input"):
-                batch = next(data_iter)
-                data, labels = to_jax_batch(batch)
-            t1 = time.perf_counter()
-            data_time = t1 - t0
-            rng, step_rng = jax.random.split(rng)
-            with trace.span("device step"):
-                # dispatch only — loss stays on device; the packed
-                # readback happens at drain time (docs/PERFORMANCE.md)
-                params, mstate, opt_state, loss = jit_step(
-                    params, mstate, opt_state, step_rng, data, labels,
-                    jnp.asarray(driver_state["epoch"], jnp.int32))
-            t2 = time.perf_counter()
-            self._telemetry_step()
-            n = int(data.shape[0])
-            count_this_epoch += n
-            batches_this_epoch += 1
-            pending.append({"epoch": driver_state["epoch"],
-                            "count": count_this_epoch,
-                            "epoch_size": epoch_size,
-                            "neval": driver_state["neval"],
-                            "wallclock": time.perf_counter()
-                            - wallclock_start,
-                            "loss": loss, "n": n,
-                            "step_time": t2 - t0, "data_time": data_time,
-                            "device_time": t2 - t1})
-            if len(pending) >= window:
-                self._drain_pending(pending, driver_state,
-                                    lockstep or "window full")
-            driver_state["neval"] += 1
-            if count_this_epoch >= epoch_size:
-                self._drain_pending(pending, driver_state, "epoch end")
-                driver_state["epoch"] += 1
-                driver_state["is_epoch_end"] = True
-                count_this_epoch = 0
-                batches_this_epoch = 0
-                self.dataset.shuffle()
-                epoch_start_host_rng = self._host_rng_snapshot()
-                data_iter = self.dataset.data(train=True)
-            fire_val, fire_ckpt = self._fires(driver_state)
-            if fire_val or fire_ckpt:
-                # validation/checkpoint read host-visible state: flush the
-                # window first, then publish params (syncing the module
-                # tree every iteration is pure host overhead)
-                self._drain_pending(pending, driver_state,
-                                    "validation/checkpoint trigger")
-                model.sync(params, mstate)
-            self._validate(jit_eval, params, mstate, driver_state,
-                           fire=fire_val)
-            self._checkpoint(driver_state, opt_state, rng,
-                             count_this_epoch, batches_this_epoch,
-                             epoch_start_host_rng, fire=fire_ckpt)
+        try:
+            while self.end_when is None or not self.end_when(driver_state):
+                driver_state["is_epoch_end"] = False
+                self._profile_hook(driver_state["neval"])
+                t0 = time.perf_counter()
+                with trace.span("input wait"):
+                    # at depth >= 1 this is a queue pop — assembly and
+                    # placement happened on the worker ("input produce")
+                    batch = next(pipeline)
+                t1 = time.perf_counter()
+                data_time = t1 - t0
+                data, labels = batch.data, batch.labels
+                n = int(batch.valid if batch.valid is not None
+                        else data.shape[0])
+                rng, step_rng = jax.random.split(rng)
+                step_args = (params, mstate, opt_state, step_rng, data,
+                             labels,
+                             jnp.asarray(driver_state["epoch"], jnp.int32))
+                if use_mask:
+                    step_args += (jnp.asarray(n, jnp.int32),)
+                with trace.span("device step"):
+                    # dispatch only — loss stays on device; the packed
+                    # readback happens at drain time (docs/PERFORMANCE.md)
+                    params, mstate, opt_state, loss = jit_step(*step_args)
+                t2 = time.perf_counter()
+                self._telemetry_step()
+                count_this_epoch += n
+                batches_this_epoch += 1
+                pending.append({"epoch": driver_state["epoch"],
+                                "count": count_this_epoch,
+                                "epoch_size": epoch_size,
+                                "neval": driver_state["neval"],
+                                "wallclock": time.perf_counter()
+                                - wallclock_start,
+                                "loss": loss, "n": n,
+                                "step_time": t2 - t0,
+                                "data_time": data_time,
+                                "device_time": t2 - t1})
+                if len(pending) >= window:
+                    self._drain_pending(pending, driver_state,
+                                        lockstep or "window full")
+                driver_state["neval"] += 1
+                if count_this_epoch >= epoch_size:
+                    self._drain_pending(pending, driver_state, "epoch end")
+                    driver_state["epoch"] += 1
+                    driver_state["is_epoch_end"] = True
+                    count_this_epoch = 0
+                    batches_this_epoch = 0
+                    # drain + join the worker BEFORE shuffle() touches
+                    # the order it iterates (thread-safety contract,
+                    # dataset/prefetch.py), then restart it on the fresh
+                    # epoch's iterator
+                    pipeline.close()
+                    self.dataset.shuffle()
+                    epoch_start_host_rng = self._host_rng_snapshot()
+                    pipeline = self._open_train_pipeline(place)
+                fire_val, fire_ckpt = self._fires(driver_state)
+                if fire_val or fire_ckpt:
+                    # validation/checkpoint read host-visible state: flush
+                    # the window first, then publish params (syncing the
+                    # module tree every iteration is pure host overhead)
+                    self._drain_pending(pending, driver_state,
+                                        "validation/checkpoint trigger")
+                    model.sync(params, mstate)
+                self._validate(jit_eval, params, mstate, driver_state,
+                               fire=fire_val)
+                self._checkpoint(driver_state, opt_state, rng,
+                                 count_this_epoch, batches_this_epoch,
+                                 epoch_start_host_rng, fire=fire_ckpt)
+        finally:
+            pipeline.close()
 
         self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()

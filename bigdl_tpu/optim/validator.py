@@ -11,9 +11,19 @@ import jax
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet, to_jax_batch
+from bigdl_tpu.dataset.prefetch import PrefetchIterator
 
 __all__ = ["Validator", "LocalValidator", "DistriValidator",
            "local_sharded_eval"]
+
+
+def _eval_batches(dataset: AbstractDataSet, name: str):
+    """One evaluation pass with batch assembly prefetched: the worker
+    runs the dataset's transform chain while the consumer dispatches
+    eval on the previous batch (dataset/prefetch.py — the validators'
+    rendering of the train loop's overlapped input pipeline)."""
+    return PrefetchIterator(dataset.data(train=False), depth=2,
+                            name=name, dataset=dataset)
 
 
 def _record_validation(summary, results, methods, step: int) -> None:
@@ -46,12 +56,14 @@ class LocalValidator:
             return out
 
         results = [None] * len(methods)
-        for batch in self.dataset.data(train=False):
-            data, labels = to_jax_batch(batch)
-            out = eval_apply(model.params, model.state, data)
-            for i, m in enumerate(methods):
-                r = m(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
+        with _eval_batches(self.dataset, "local eval") as batches:
+            for batch in batches:
+                data, labels = to_jax_batch(batch)
+                out = eval_apply(model.params, model.state, data)
+                for i, m in enumerate(methods):
+                    r = m(out, labels)
+                    results[i] = r if results[i] is None \
+                        else results[i] + r
         _record_validation(summary, results, methods, step)
         return list(zip(results, methods))
 
@@ -161,12 +173,14 @@ class DistriValidator:
 
         run = _padded_eval(eval_apply, self._shard, self._n_shards)
         results = [None] * len(methods)
-        for batch in self.dataset.data(train=False):
-            out = run(params, mstate, batch.data)
-            labels = np.asarray(batch.labels)
-            for i, m in enumerate(methods):
-                r = m(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
+        with _eval_batches(self.dataset, "distri eval") as batches:
+            for batch in batches:
+                out = run(params, mstate, batch.data)
+                labels = np.asarray(batch.labels)
+                for i, m in enumerate(methods):
+                    r = m(out, labels)
+                    results[i] = r if results[i] is None \
+                        else results[i] + r
         _record_validation(summary, results, methods, step)
         return list(zip(results, methods))
 
@@ -194,12 +208,14 @@ class DistriValidator:
 
         run = local_sharded_eval(apply_fn)
         results = [None] * len(methods)
-        for batch in self.dataset.data(train=False):
-            out = run(params, mstate, batch.data)   # numpy; methods take
-            labels = np.asarray(batch.labels)       # host arrays directly
-            for i, m in enumerate(methods):
-                r = m(out, labels)
-                results[i] = r if results[i] is None else results[i] + r
+        with _eval_batches(self.dataset, "multihost eval") as batches:
+            for batch in batches:
+                out = run(params, mstate, batch.data)  # numpy; methods
+                labels = np.asarray(batch.labels)      # take host arrays
+                for i, m in enumerate(methods):
+                    r = m(out, labels)
+                    results[i] = r if results[i] is None \
+                        else results[i] + r
         merged = aggregate_results(results)
         _record_validation(summary, merged, methods, step)
         return list(zip(merged, methods))

@@ -47,6 +47,16 @@ class RandomGenerator:
         return cls._local.inst
 
     @classmethod
+    def adopt(cls, inst: "RandomGenerator") -> "RandomGenerator":
+        """Bind THIS thread's ``RNG()`` to an existing generator
+        instance. The prefetch worker (dataset/prefetch.py) adopts its
+        creator thread's generator so pipeline augmentation draws
+        continue the exact stream the synchronous loop would have used
+        — thread-local isolation would silently fork it."""
+        cls._local.inst = inst
+        return inst
+
+    @classmethod
     def seed_worker(cls, worker_index: int, invocation: int = 0
                     ) -> "RandomGenerator":
         """Seed a worker thread's generator with a stream distinct per

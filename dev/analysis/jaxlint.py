@@ -59,8 +59,12 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "baseline.txt")
 
 # packages that must stay importable without jax (host-only contract);
-# extend as new host-only subsystems appear
-HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",)
+# extend as new host-only subsystems appear. dataset/prefetch.py: the
+# input pipeline's queue/thread machinery is host-only — its sanctioned
+# placement calls (device_put / make_array_from_process_local_data)
+# lazy-import jax inside the functions that issue them
+HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
+                      "bigdl_tpu/dataset/prefetch.py")
 
 # the per-iteration-sync flavor of JX1 only applies to library code:
 # tests and dev tooling are host drivers that sync deliberately

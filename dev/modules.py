@@ -13,7 +13,7 @@ MODULES = {
     "kernels": ["tests/test_fused_ce.py", "tests/test_maxpool_kernel.py"],
     "tensor": ["tests/test_ref_oracle.py", "tests/test_golden_fixtures.py"],
     "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
-                "tests/test_native_loader.py"],
+                "tests/test_native_loader.py", "tests/test_prefetch.py"],
     "optim": ["tests/test_optim.py", "tests/test_checkpoint.py",
               "tests/test_predictor.py", "tests/test_async_dispatch.py"],
     "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",

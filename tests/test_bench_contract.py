@@ -155,6 +155,41 @@ def test_init_timeout_flag_beats_env(monkeypatch):
     assert seen["timeout"] == 2.5
 
 
+class TestInputPipelineOverlapRow:
+    """ISSUE 5 satellite: the input_pipeline_overlap metric — fraction
+    of step wall time spent in `input wait` at prefetch depth 0 vs
+    depth 2 — rides the standard row/registry contract."""
+
+    def test_row_shape_and_registry_export(self, tmp_path):
+        row = bench.bench_input_pipeline_overlap(iters=5)
+        assert row["metric"] == "input_pipeline_overlap"
+        assert row["unit"] == "fraction of step wall time"
+        for k in ("input_wait_frac_depth0", "input_wait_frac_depth2"):
+            assert 0.0 <= row[k] <= 1.0, (k, row)
+        # the overlap won is the difference of the two fractions
+        # (clamped at 0 — scheduling noise must not go negative)
+        assert 0.0 <= row["value"] <= 1.0
+
+    def test_main_wires_row_into_metrics_out(self, monkeypatch, capsys,
+                                             tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        fake = {"metric": "input_pipeline_overlap", "value": 0.25,
+                "unit": "fraction of step wall time",
+                "input_wait_frac_depth0": 0.3,
+                "input_wait_frac_depth2": 0.05, "iters": 4}
+        monkeypatch.setattr(bench, "bench_input_pipeline_overlap",
+                            lambda iters=12, batch=64: dict(fake))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "input_pipeline", "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "input_pipeline_overlap"
+        assert lines[-1]["rows"][0]["value"] == 0.25
+        with open(out) as f:
+            text = f.read()
+        assert "bench_input_pipeline_overlap 0.25" in text
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:

@@ -368,6 +368,30 @@ class TestJX5HostOnlyImports:
         """, rel="bigdl_tpu/observability/tracing.py")
         assert out == []
 
+    def test_prefetch_queue_machinery_is_host_only(self):
+        """ISSUE 5 satellite pin: dataset/prefetch.py's queue/thread
+        machinery is host-only — a module-level jax import there is a
+        JX5 finding; the sanctioned placement calls (device_put /
+        make_array_from_process_local_data) stay function-local; and
+        the shipped file is clean."""
+        rel = "bigdl_tpu/dataset/prefetch.py"
+        out = lint(self.SRC, rel=rel)
+        assert rules(out) == ["JX5"]
+        # the sanctioned lazy-import placement shape is clean
+        out = lint("""
+            def place_batch(self, b):
+                import jax
+                return jax.device_put(b.data, self.sharding)
+        """, rel=rel)
+        assert out == []
+        # other dataset modules are NOT host-only pinned
+        assert lint(self.SRC, rel="bigdl_tpu/dataset/recordio.py") == []
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "bigdl_tpu", "dataset", "prefetch.py")
+        assert os.path.exists(path), path
+        found = jaxlint.analyze_file(path, repo)
+        assert [f for f in found if f.rule == "JX5"] == [], path
+
     def test_telemetry_plane_modules_are_covered(self):
         """Satellite pin: the host-only prefix covers the telemetry
         plane — a module-level jax import in exporter.py /

@@ -383,8 +383,11 @@ class TestOptimizerIntegration:
         for ev in events:
             assert "ph" in ev and "ts" in ev and "name" in ev
         names = {e["name"] for e in events}
-        assert {"host input", "compile step", "device step", "loss drain",
-                "validation"} <= names
+        # ISSUE 5: the host input phase is split into the consumer's
+        # "input wait" (a queue pop under prefetch) and the worker-side
+        # "input produce" (assembly + placement)
+        assert {"input wait", "input produce", "compile step",
+                "device step", "loss drain", "validation"} <= names
         # async dispatch: the device step span is dispatch-only; the
         # intentional sync lives in the packed "loss drain" span
         dstep = [e for e in events if e["name"] == "device step"]

@@ -1,0 +1,576 @@
+"""Overlapped input-pipeline contract (CPU-pinned, ISSUE 5).
+
+The train loops now dequeue batches from a threaded prefetch pipeline
+(``dataset/prefetch.py``) that assembles and device-places them ahead
+of the loop, and can pad each pass's final partial batch to the full
+shape with an in-step validity mask. These tests pin the contract:
+
+- ``PrefetchIterator`` semantics: order, exception propagation, clean
+  shutdown, the epoch-record bound, the worker-vs-``shuffle()``
+  thread-safety guard, starvation/queue-depth observability;
+- trajectories at prefetch depth 2 are BIT-IDENTICAL to the
+  synchronous (depth 0) loop for both optimizers — including across a
+  mid-epoch checkpoint/resume with pass-crossing batches (the case
+  where the worker's read-ahead would corrupt a live position read);
+- ``pad_partial_batches=True`` holds the train step at exactly ONE
+  compile per step name across a multi-epoch non-divisible run, and
+  padded rows provably contribute zero to loss and gradient
+  (``nn.MaskedCriterion``);
+- the validation path rides the same prefetcher and leaves no worker
+  threads behind.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import (MiniBatch, Sample, SampleToBatch,
+                               Transformer, array)
+from bigdl_tpu.dataset.dataset import iterator_source
+from bigdl_tpu.dataset.prefetch import (PadPartialBatches,
+                                        PrefetchIterator)
+from bigdl_tpu.observability import SummaryReader, TrainSummary
+from bigdl_tpu.observability import compile_watch
+from bigdl_tpu.observability.registry import default_registry
+from bigdl_tpu.utils import file as bfile
+from bigdl_tpu.utils.random import RandomGenerator
+
+BATCH = 32
+N_SAMPLES = 128
+
+
+def _batches(sizes, dim=3, seed=0):
+    rs = np.random.RandomState(seed)
+    out = []
+    for i, n in enumerate(sizes):
+        out.append(MiniBatch(rs.rand(n, dim).astype(np.float32),
+                             rs.randint(1, 3, size=(n,))))
+    return out
+
+
+def _samples(n=N_SAMPLES, seed=3):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    return [Sample(x[i], y[i]) for i in range(n)]
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(2, 16), nn.Tanh(),
+                         nn.Linear(16, 2), nn.LogSoftMax())
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("prefetch:") and t.is_alive()]
+
+
+class _HostNoise(Transformer):
+    """Per-batch draw from the SHARED host RNG stream — read-ahead that
+    reordered or over-consumed draws would change the data and break
+    the bit-identical contract."""
+
+    def __call__(self, it):
+        for b in it:
+            noise = RandomGenerator.RNG().normal(
+                0.0, 1e-3, np.asarray(b.data).shape).astype(np.float32)
+            yield MiniBatch(np.asarray(b.data) + noise, b.labels)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator unit semantics
+# ---------------------------------------------------------------------------
+
+class TestPrefetchIterator:
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_order_and_completeness(self, depth):
+        batches = _batches([4] * 10)
+        pf = PrefetchIterator(iter(batches), depth=depth)
+        got = list(pf)
+        assert len(got) == 10
+        for want, have in zip(batches, got):
+            np.testing.assert_array_equal(want.data, have.data)
+        assert not pf.running
+
+    def test_stage_runs_on_worker_thread(self):
+        seen = []
+
+        def stage(b):
+            seen.append(threading.get_ident())
+            return b
+
+        list(PrefetchIterator(iter(_batches([2] * 4)), stage=stage))
+        assert seen and all(t != threading.get_ident() for t in seen)
+
+    def test_exception_propagates_after_good_batches(self):
+        def source():
+            yield from _batches([2, 2])
+            raise ValueError("decode exploded")
+
+        pf = PrefetchIterator(source(), depth=2)
+        assert next(pf) is not None
+        assert next(pf) is not None
+        with pytest.raises(ValueError, match="decode exploded"):
+            next(pf)
+        assert not pf.running
+
+    def test_close_joins_worker_mid_stream(self):
+        def slow():
+            for b in _batches([2] * 100):
+                time.sleep(0.005)
+                yield b
+
+        pf = PrefetchIterator(slow(), depth=1, name="slowtest")
+        next(pf)
+        pf.close()
+        assert not pf.running
+        assert not [t for t in _prefetch_threads()
+                    if t.name == "prefetch:slowtest"]
+        pf.close()   # idempotent
+
+    def test_epoch_record_bound_stops_worker_pulls(self):
+        """max_records: the worker pulls exactly through the batch that
+        crosses the bound — the same place the train loop declares
+        epoch end — and not one batch further (read-ahead must not leak
+        into the next pass's RNG draws)."""
+        pulls = {"n": 0}
+
+        def endless():
+            while True:
+                pulls["n"] += 1
+                yield MiniBatch(np.zeros((32, 2), np.float32),
+                                np.ones(32))
+
+        pf = PrefetchIterator(endless(), depth=4, max_records=100)
+        got = list(pf)          # worker stops on its own
+        assert len(got) == 4    # 32*4 = 128 >= 100, crossing batch kept
+        assert pulls["n"] == 4
+        assert not pf.running
+
+    def test_records_scale_matches_global_accounting(self):
+        """DistriOptimizer counts records globally (local * processes);
+        the bound must stop at the same batch."""
+        pf = PrefetchIterator(iter(_batches([8] * 10)), depth=2,
+                              max_records=32, records_scale=2)
+        assert len(list(pf)) == 2   # 8*2 per batch globally, 32 bound
+
+    def test_rewrap_guard_enforces_close_before_shuffle(self):
+        """Thread-safety contract: a dataset with a live worker may not
+        be re-wrapped (the epoch handoff must drain + join first)."""
+        ds = array(_samples(32)) >> SampleToBatch(8)
+        pf = PrefetchIterator(ds.data(train=True), depth=1, dataset=ds)
+        with pytest.raises(RuntimeError, match="live prefetch worker"):
+            PrefetchIterator(ds.data(train=True), depth=1, dataset=ds)
+        pf.close()
+        PrefetchIterator(ds.data(train=True), depth=1, dataset=ds).close()
+
+    def test_starvation_counter_and_queue_gauge(self):
+        def starving():
+            for b in _batches([2] * 3):
+                time.sleep(0.02)
+                yield b
+
+        reg = default_registry()
+        c = reg.counter("input_starvation_total",
+                        "consumer blocked on an empty prefetch queue",
+                        labelnames=("pipeline",))
+        before = c.value(pipeline="starver")
+        list(PrefetchIterator(starving(), depth=2, name="starver"))
+        assert c.value(pipeline="starver") > before
+        assert reg.get("prefetch_queue_depth") is not None
+
+
+# ---------------------------------------------------------------------------
+# partial-batch padding + masked criterion
+# ---------------------------------------------------------------------------
+
+class TestPadPartialBatches:
+    def test_pads_to_largest_seen_with_valid_count(self):
+        pad = PadPartialBatches()
+        full = pad(MiniBatch(np.ones((8, 3), np.float32), np.arange(8)))
+        assert full.data.shape == (8, 3) and full.valid == 8
+        short = pad(MiniBatch(np.zeros((3, 3), np.float32),
+                              np.arange(3)))
+        assert short.data.shape == (8, 3) and short.valid == 3
+        # labels edge-repeat (a zero pad would be an invalid 1-based
+        # class target)
+        np.testing.assert_array_equal(short.labels,
+                                      [0, 1, 2, 2, 2, 2, 2, 2])
+
+    def test_seeded_full_size_pads_first_batch(self):
+        """Resume can start ON the short batch: the checkpointed full
+        size must win over the first-seen shape."""
+        pad = PadPartialBatches(8)
+        short = pad(MiniBatch(np.zeros((3, 3), np.float32), np.arange(3)))
+        assert short.data.shape == (8, 3) and short.valid == 3
+
+    def test_refuses_device_batches(self):
+        pad = PadPartialBatches()
+        with pytest.raises(ValueError, match="host batches"):
+            pad(MiniBatch(jnp.zeros((4, 3)), jnp.zeros((4,))))
+
+
+class TestMaskedCriterion:
+    def _padded(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(5, 4).astype(np.float32))
+        t = jnp.asarray(rs.randint(1, 5, size=(5,)))
+        logp = jax.nn.log_softmax(x)
+        pad_x = jnp.concatenate([logp, jnp.tile(logp[-1:], (3, 1))])
+        pad_t = jnp.concatenate([t, jnp.tile(t[-1:], (3,))])
+        mask = jnp.asarray([1.0] * 5 + [0.0] * 3)
+        return logp, t, pad_x, pad_t, mask
+
+    def test_masked_loss_equals_unpadded_loss(self):
+        logp, t, pad_x, pad_t, mask = self._padded()
+        base = nn.ClassNLLCriterion()
+        want = base.apply(logp, t)
+        got = nn.MaskedCriterion(base).apply(pad_x, pad_t, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_padded_rows_have_exactly_zero_gradient(self):
+        logp, t, pad_x, pad_t, mask = self._padded()
+        masked = nn.MaskedCriterion(nn.ClassNLLCriterion())
+        g = jax.grad(lambda x: masked.apply(x, pad_t, mask))(pad_x)
+        g = np.asarray(g)
+        np.testing.assert_array_equal(g[5:], np.zeros_like(g[5:]))
+        # valid rows match the unpadded gradient bit-for-bit shape-wise
+        base = nn.ClassNLLCriterion()
+        g_ref = np.asarray(jax.grad(lambda x: base.apply(x, t))(logp))
+        np.testing.assert_allclose(g[:5], g_ref, rtol=1e-6)
+
+    def test_size_average_false_uses_masked_sum(self):
+        logp, t, pad_x, pad_t, mask = self._padded()
+        base = nn.ClassNLLCriterion(size_average=False)
+        want = base.apply(logp, t)
+        got = nn.MaskedCriterion(base).apply(pad_x, pad_t, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# training-loop contract: depth 2 == depth 0, bit-identical
+# ---------------------------------------------------------------------------
+
+def _run(end_when, *, depth, mesh=None, ckpt_dir=None, summary=None,
+         noisy=False, resume_state=None, model=None):
+    """One deterministic run; two runs differing only in prefetch depth
+    see identical data order and initial params."""
+    RandomGenerator.set_seed(11)
+    ds = array(_samples()) >> SampleToBatch(BATCH)
+    if noisy:
+        ds = ds >> _HostNoise()
+    model = model or _mlp()
+    if mesh is not None:
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    else:
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(optim.SGD(learning_rate=0.5, momentum=0.9))
+    o.set_end_when(end_when)
+    o.set_input_pipeline(depth=depth)
+    if resume_state is not None:
+        o.set_state(resume_state)
+    if ckpt_dir is not None:
+        o.set_checkpoint(str(ckpt_dir), optim.every_epoch())
+        o.overwrite_checkpoint()
+    if summary is not None:
+        o.set_train_summary(summary)
+    trained = o.optimize()
+    return trained, o
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture
+def data_mesh():
+    from bigdl_tpu.parallel import Engine
+    Engine.reset()
+    yield Engine.init(axes={"data": 8})
+    Engine.reset()
+
+
+class TestBitIdentical:
+    """Moving input assembly + placement onto a worker thread must not
+    change a single bit of the trajectory."""
+
+    @pytest.mark.parametrize("noisy", [False, True],
+                             ids=["plain", "host-rng-transform"])
+    def _compare(self, tmp_path, mesh=None, noisy=False):
+        n = 9   # crosses two epoch boundaries (4 batches/epoch)
+        runs = {}
+        for name, depth in (("sync", 0), ("async", 2)):
+            tag = name + ("_d" if mesh is not None else "_l") + \
+                ("_n" if noisy else "")
+            ts = TrainSummary(str(tmp_path), tag)
+            ck = tmp_path / tag
+            trained, _ = _run(optim.max_iteration(n), depth=depth,
+                              mesh=mesh, ckpt_dir=ck, summary=ts,
+                              noisy=noisy)
+            state = bfile.load(str(ck / "state"))
+            runs[name] = (jax.tree.map(np.asarray, trained.params),
+                          SummaryReader(ts.path).scalars("Loss"),
+                          state["opt_state"])
+        p_sync, loss_sync, opt_sync = runs["sync"]
+        p_async, loss_async, opt_async = runs["async"]
+        _assert_tree_equal(p_sync, p_async)
+        _assert_tree_equal(opt_sync, opt_async)
+        assert [s[0] for s in loss_sync] == list(range(1, n + 1))
+        assert [s[2] for s in loss_sync] == [s[2] for s in loss_async]
+
+    def test_local(self, tmp_path):
+        self._compare(tmp_path)
+
+    def test_local_with_host_rng_transform(self, tmp_path):
+        """The transform draws from the shared host RNG per batch: the
+        worker's read-ahead must consume draws in exactly the sync
+        order (it is epoch-bounded, so it does)."""
+        self._compare(tmp_path, noisy=True)
+
+    def test_distri(self, tmp_path, data_mesh):
+        self._compare(tmp_path, mesh=data_mesh)
+
+    def test_distri_with_host_rng_transform(self, tmp_path, data_mesh):
+        self._compare(tmp_path, mesh=data_mesh, noisy=True)
+
+    def test_no_worker_threads_leak(self, tmp_path):
+        before = len(_prefetch_threads())
+        _run(optim.max_iteration(6), depth=2)
+        assert len(_prefetch_threads()) == before
+
+
+class TestCheckpointResumeWithPrefetch:
+    """Mid-epoch stop at depth 2, resume, and the replayed batch
+    sequence is bit-identical to an uninterrupted depth-0 run — with a
+    batch size that does NOT divide the shard (pass-crossing batches),
+    the case where checkpointing the LIVE position state would record
+    the worker's read-ahead instead of the consumer's position."""
+
+    N, B = 104, 16   # 104/16 = 6.5 batches/pass: batch 7 crosses
+
+    def _run(self, iters, depth, ckpt_dir=None, resume_from=None,
+             mesh=None):
+        RandomGenerator.set_seed(5)
+        shards = {"num_shards": 1} if mesh is not None else {}
+        ds = array(_samples(self.N), **shards) >> SampleToBatch(self.B)
+        if resume_from is not None:
+            model = bfile.load_module(f"{resume_from}/model.10")
+            state = bfile.load(f"{resume_from}/state.10")
+        else:
+            model, state = _mlp(), None
+        if mesh is not None:
+            from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+            o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                mesh=mesh)
+        else:
+            o = optim.Optimizer(model=model, dataset=ds,
+                                criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+        o.set_input_pipeline(depth=depth)
+        if state is not None:
+            o.set_state(state)
+        if ckpt_dir is not None:
+            o.set_checkpoint(str(ckpt_dir), optim.several_iteration(10))
+        o.set_end_when(optim.max_iteration(iters))
+        losses = []
+        import logging
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                msg = record.getMessage()
+                if "loss is" in msg:
+                    losses.append(float(
+                        msg.split("loss is ")[1].split(",")[0]))
+
+        lg = logging.getLogger("bigdl_tpu.optim")
+        prev = lg.level
+        lg.setLevel(logging.INFO)
+        h = Grab()
+        lg.addHandler(h)
+        try:
+            trained = o.optimize()
+        finally:
+            lg.removeHandler(h)
+            lg.setLevel(prev)
+        return losses, jax.tree.map(np.asarray, trained.params)
+
+    @pytest.mark.parametrize("mesh_fix", [False, True],
+                             ids=["local", "distri-8dev"])
+    def test_resume_replays_identical_sequence(self, tmp_path,
+                                               mesh_fix, request):
+        mesh = request.getfixturevalue("data_mesh") if mesh_fix else None
+        full, p_full = self._run(16, depth=0, mesh=mesh)
+        assert len(full) == 16
+        ck = tmp_path / ("d" if mesh_fix else "l")
+        first, _ = self._run(10, depth=2, ckpt_dir=ck, mesh=mesh)
+        np.testing.assert_allclose(first, full[:10], rtol=1e-6)
+        resumed, p_res = self._run(16, depth=2, resume_from=str(ck),
+                                   mesh=mesh)
+        assert len(resumed) == 7
+        np.testing.assert_allclose(resumed, full[9:], rtol=1e-5)
+        # final params of the interrupted depth-2 run match the
+        # uninterrupted depth-0 run bit-for-bit
+        _assert_tree_equal(p_res, p_full)
+
+
+# ---------------------------------------------------------------------------
+# pad_partial_batches: exactly one compile per step name
+# ---------------------------------------------------------------------------
+
+class TestPadCompileCount:
+    """Acceptance: with pad_partial_batches=True, a multi-epoch run over
+    a non-divisible dataset compiles the train step EXACTLY once (vs 2
+    today — one full-shape, one partial-shape signature)."""
+
+    def _dataset(self, sizes=(32, 32, 16)):
+        batches = _batches(list(sizes), dim=2, seed=1)
+        return iterator_source(lambda: iter(batches),
+                               size=int(sum(sizes)))
+
+    def _train(self, pad, mesh=None, iters=7):
+        # 7 iterations = 2 full epochs + 1: the partial shape recurs
+        RandomGenerator.set_seed(2)
+        compile_watch.reset()
+        ds = self._dataset()
+        model = _mlp()
+        if mesh is not None:
+            from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+            o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                mesh=mesh)
+        else:
+            o = optim.Optimizer(model=model, dataset=ds,
+                                criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_input_pipeline(depth=2, pad_partial_batches=pad)
+        o.set_end_when(optim.max_iteration(iters))
+        o.optimize()
+        return o
+
+    def test_local_single_compile(self):
+        self._train(pad=False)
+        assert compile_watch.table()["local_train_step"]["compiles"] == 2
+        o = self._train(pad=True)
+        assert compile_watch.table()["local_train_step"]["compiles"] == 1
+        # the padded epoch consumed the true record count
+        assert o.metrics.stats("device step time")["n"] == 7
+
+    def test_distri_single_compile(self, data_mesh):
+        self._train(pad=False, mesh=data_mesh)
+        assert compile_watch.table()["distri_train_step"]["compiles"] == 2
+        self._train(pad=True, mesh=data_mesh)
+        assert compile_watch.table()["distri_train_step"]["compiles"] == 1
+
+    def test_padded_loss_matches_unpadded_per_step(self, tmp_path):
+        """Padding must not change the reported loss of the short batch
+        (masked mean == partial-batch mean)."""
+        losses = {}
+        for pad in (False, True):
+            RandomGenerator.set_seed(2)
+            ds = self._dataset()
+            ts = TrainSummary(str(tmp_path), f"pad{pad}")
+            o = optim.Optimizer(model=_mlp(), dataset=ds,
+                                criterion=nn.ClassNLLCriterion())
+            o.set_optim_method(optim.SGD(learning_rate=0.1))
+            o.set_input_pipeline(depth=2, pad_partial_batches=pad)
+            o.set_train_summary(ts)
+            o.set_end_when(optim.max_iteration(3))
+            o.optimize()
+            losses[pad] = [s[2] for s in
+                           SummaryReader(ts.path).scalars("Loss")]
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5)
+
+    def test_pad_full_size_round_trips_through_checkpoint(self,
+                                                          tmp_path):
+        RandomGenerator.set_seed(2)
+        o = optim.Optimizer(model=_mlp(), dataset=self._dataset(),
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_input_pipeline(depth=2, pad_partial_batches=True)
+        o.set_checkpoint(str(tmp_path), optim.several_iteration(4))
+        o.set_end_when(optim.max_iteration(4))
+        o.optimize()
+        state = bfile.load(str(tmp_path / "state.4"))
+        assert int(np.asarray(state["pad_full_size"])) == 32
+
+
+# ---------------------------------------------------------------------------
+# validation path + epoch-boundary stress
+# ---------------------------------------------------------------------------
+
+class TestValidationPrefetch:
+    def test_validation_results_identical_and_workers_join(self,
+                                                           tmp_path):
+        results = {}
+        for depth in (0, 2):
+            RandomGenerator.set_seed(7)
+            ds = array(_samples()) >> SampleToBatch(BATCH)
+            val = array(_samples(64, seed=9)) >> SampleToBatch(BATCH)
+            o = optim.Optimizer(model=_mlp(), dataset=ds,
+                                criterion=nn.ClassNLLCriterion())
+            o.set_optim_method(optim.SGD(learning_rate=0.5))
+            o.set_input_pipeline(depth=depth)
+            o.set_validation(optim.every_epoch(), val,
+                             [optim.Top1Accuracy()])
+            o.set_end_when(optim.max_iteration(8))
+            trained = o.optimize()
+            res = optim.LocalValidator(
+                trained, array(_samples(64, seed=9)) >>
+                SampleToBatch(BATCH)).test([optim.Top1Accuracy()])
+            results[depth] = res[0][0].result()[0]
+        assert results[0] == results[2]
+        assert not _prefetch_threads()
+
+    def test_standalone_validators_use_prefetch(self):
+        """LocalValidator/DistriValidator ride PrefetchIterator; the
+        eval pass consumes every batch and joins its worker."""
+        model = _mlp()
+        model.materialize(jax.random.PRNGKey(0))
+        res = optim.LocalValidator(
+            model, array(_samples(64)) >> SampleToBatch(16)
+        ).test([optim.Top1Accuracy()])
+        assert res[0][0].result()[1] == 64   # all records evaluated
+        assert not _prefetch_threads()
+
+
+class TestEpochBoundaryStress:
+    """Satellite: many epochs, tiny queue — a wrong drain/restart
+    handoff around shuffle() would deadlock (close() raises after its
+    timeout) or drop/reorder batches (the loss series would diverge
+    from the sync run)."""
+
+    def _series(self, depth, epochs=30):
+        RandomGenerator.set_seed(13)
+        ds = array(_samples(48, seed=1)) >> SampleToBatch(16)
+        o = optim.Optimizer(model=_mlp(), dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.2))
+        o.set_input_pipeline(depth=depth)
+        o.set_end_when(optim.max_epoch(epochs))
+        ts_dir = None
+        import tempfile
+        ts_dir = tempfile.mkdtemp()
+        ts = TrainSummary(ts_dir, f"stress{depth}")
+        o.set_train_summary(ts)
+        o.optimize()
+        return [s[2] for s in SummaryReader(ts.path).scalars("Loss")]
+
+    def test_thirty_epochs_depth1_matches_sync(self):
+        sync = self._series(0)
+        tiny = self._series(1)
+        assert len(sync) == len(tiny) == 30 * 3   # 3 batches/epoch
+        assert sync == tiny
+        assert not _prefetch_threads()
