@@ -31,6 +31,7 @@ GQA/MQA decode path (BASELINE.md round-4: 190k tok/s) moves next.
 from __future__ import annotations
 
 import functools
+import logging
 import time
 
 import jax
@@ -46,7 +47,8 @@ from bigdl_tpu.observability.registry import default_registry
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
 __all__ = ["generate_ragged", "PagedKVCache", "paged_prefill",
-           "paged_decode", "speculative_generate", "ContinuousBatcher"]
+           "paged_decode", "speculative_generate", "ContinuousBatcher",
+           "KVSnapshot"]
 
 
 def _rope_rows(x, positions, theta: float = 10000.0):
@@ -740,6 +742,62 @@ def speculative_generate(model, draft_model, prompts, *,
 
 
 # ---------------------------------------------------------------------------
+# KV handoff
+# ---------------------------------------------------------------------------
+
+class KVSnapshot:
+    """Host-side export of one request's KV state — the handoff unit
+    for prefix-cache reuse, prefill/decode disaggregation, and drain
+    migration (the serving router, ``bigdl_tpu/serving/``).
+
+    ``kv`` is a per-layer list of ``(k, v)`` numpy arrays shaped
+    ``(n_pages, page_size, kv_heads, head_dim)``: the request's pages
+    gathered off the pool in one packed ``jax.device_get``. The first
+    ``n_cached`` token positions are valid; ``emitted`` tokens (always
+    starting with the prefill's first sampled token) have already been
+    produced; ``last_token`` is the next decode step's input. Adopting
+    a snapshot re-allocates pages and scatters the data back in —
+    greedy decode then continues bitwise identically to the exporting
+    batcher, because the continuation is a pure function of
+    (params, KV state, last token) (test-pinned in
+    tests/test_serving_router.py)."""
+
+    __slots__ = ("prompt", "n_cached", "kv", "last_token", "emitted",
+                 "page_size")
+
+    def __init__(self, prompt, n_cached, kv, last_token, emitted,
+                 page_size):
+        self.prompt = list(prompt)
+        self.n_cached = int(n_cached)
+        self.kv = kv
+        self.last_token = int(last_token)
+        self.emitted = list(emitted)
+        self.page_size = int(page_size)
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.kv[0][0].shape[0]) if self.kv else 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(k.nbytes) + int(v.nbytes) for k, v in self.kv)
+
+    def __repr__(self):
+        return (f"KVSnapshot(prompt_len={len(self.prompt)}, "
+                f"n_cached={self.n_cached}, n_pages={self.n_pages}, "
+                f"emitted={len(self.emitted)})")
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(pool, idx, data):
+    """Adopt-side scatter: write snapshot pages ``data`` into pool rows
+    ``idx``. Donated so adoption does not copy the whole pool; compiles
+    once per (pool geometry, page count) — counts are bucketed by the
+    export side, so signatures stay O(log max_len)."""
+    return pool.at[idx].set(data.astype(pool.dtype))
+
+
+# ---------------------------------------------------------------------------
 # Continuous batching
 # ---------------------------------------------------------------------------
 
@@ -790,7 +848,8 @@ class ContinuousBatcher:
                  page_size: int = 16, max_new_tokens: int = 32,
                  max_burst: int = 8, eos_id: int | None = None,
                  registry=None, summary=None, health=None,
-                 watch=None):
+                 watch=None, health_name: str = "serving_batcher",
+                 on_complete=None, on_prefill=None):
         meta = model.lm_meta
         self.model = model
         self.max_batch = max_batch
@@ -817,13 +876,20 @@ class ContinuousBatcher:
                              self._scratch, np.int32)
         self.lengths = np.zeros((max_batch,), np.int32)
         self.last = np.ones((max_batch,), np.int32)
-        # slot -> (request_id, prompt_len, [tokens so far]) or None
+        # slot -> (request_id, prompt tokens, [tokens so far]) or None
         self.slots: list = [None] * max_batch
         self._pages: list = [None] * max_batch
         self.queue: list = []
         self._done: list = []
         self.summary = summary
         self._step_count = 0
+        # router hooks: on_complete(request_id, tokens) fires at retire;
+        # on_prefill(request_id, prompt, snapshot_fn) fires right after
+        # a real prefill, with a LAZY exporter the callee may invoke to
+        # capture the clean prefix KV (assignable attributes — the
+        # router wires them after construction)
+        self.on_complete = on_complete
+        self.on_prefill = on_prefill
         reg = default_registry() if registry is None else registry
         self._m_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot")
@@ -846,6 +912,16 @@ class ContinuousBatcher:
         self._m_tok_lat = reg.histogram(
             "serving_decode_token_seconds",
             "per-token decode latency: burst wall clock / burst")
+        self._m_skips = reg.counter(
+            "serving_prefill_skips_total",
+            "admissions that adopted a KV snapshot instead of "
+            "running prefill")
+        self._m_cancel = reg.counter(
+            "serving_cancelled_total",
+            "requests cancelled before completion (queued or in-flight)")
+        self._m_export = reg.counter(
+            "serving_exports_total",
+            "KV snapshots exported for handoff/migration")
         # compile telemetry: signature-keyed compile counting on the
         # two step fns (module globals resolve at call time, so tests
         # that monkeypatch paged_prefill/paged_decode still intercept)
@@ -861,7 +937,10 @@ class ContinuousBatcher:
             from bigdl_tpu.observability.exporter import default_health
             health = default_health()
         self._health = health
-        self._health.register("serving_batcher", self._ready,
+        # ``health_name`` lets N replicas in one process each answer a
+        # distinct /readyz check (the router names them per replica)
+        self.health_name = str(health_name)
+        self._health.register(self.health_name, self._ready,
                               kind="readiness")
 
     def _ready(self):
@@ -892,8 +971,56 @@ class ContinuousBatcher:
         return -(-(bucket + self.max_new + self.max_burst)
                  // self.page_size)
 
-    def submit(self, request_id, prompt) -> None:
-        """Queue one request (list of 1-based token ids)."""
+    def request_ids(self) -> set:
+        """Ids currently queued or in flight (ids of FINISHED requests
+        may be reused once collected)."""
+        ids = {e[0] for e in self.queue}
+        ids.update(s[0] for s in self.slots if s is not None)
+        return ids
+
+    def _validate_snapshot(self, snap: KVSnapshot) -> None:
+        if snap.page_size != self.page_size:
+            raise ValueError(f"snapshot page_size {snap.page_size} != "
+                             f"batcher page_size {self.page_size}")
+        if len(snap.kv) != self.cache.num_layers:
+            raise ValueError(f"snapshot has {len(snap.kv)} layers, "
+                             f"cache has {self.cache.num_layers}")
+        want = (self.page_size, self.cache.kv_heads, self.cache.head_dim)
+        for li, (k, v) in enumerate(snap.kv):
+            if tuple(k.shape[1:]) != want or tuple(v.shape[1:]) != want:
+                raise ValueError(
+                    f"snapshot layer {li} page shape {k.shape[1:]} != "
+                    f"cache page shape {want}")
+        if snap.n_pages > self._need_pages(len(snap.prompt)):
+            raise ValueError(
+                f"snapshot carries {snap.n_pages} pages but this "
+                f"batcher allocates {self._need_pages(len(snap.prompt))}"
+                f" for a {len(snap.prompt)}-token prompt — exporter "
+                "geometry (max_new/max_burst/page_size) must match")
+        if snap.n_cached > snap.n_pages * self.page_size:
+            raise ValueError(
+                f"snapshot n_cached {snap.n_cached} exceeds its "
+                f"{snap.n_pages} pages x {self.page_size} slots")
+
+    def submit(self, request_id, prompt=None, *,
+               snapshot: KVSnapshot | None = None) -> None:
+        """Queue one request (list of 1-based token ids) — or, with
+        ``snapshot=``, a :class:`KVSnapshot` to ADOPT: admission then
+        allocates pages and scatters the cached KV back in instead of
+        running prefill (prefix-cache hits, disaggregated prefills and
+        drain migration all enter here). Raises on a ``request_id``
+        still queued or in flight — the router's timeout/retry story
+        needs duplicate submission to be loud, not silently doubled."""
+        if request_id in self.request_ids():
+            raise ValueError(f"duplicate request_id {request_id!r}: "
+                             "still queued or in flight")
+        if snapshot is not None:
+            if prompt is not None:
+                raise ValueError("pass prompt OR snapshot, not both")
+            self._validate_snapshot(snapshot)
+            prompt = snapshot.prompt
+        elif prompt is None:
+            raise ValueError("submit needs a prompt or a snapshot")
         if len(prompt) > self.max_prompt:
             raise ValueError(f"prompt of {len(prompt)} tokens exceeds "
                              f"max_prompt {self.max_prompt}")
@@ -904,14 +1031,41 @@ class ContinuousBatcher:
                 f"request needs {self._need_pages(len(prompt))} pages "
                 f"but the pool holds {self._pool_pages} — enlarge "
                 "num_pages or shorten the prompt/budget")
-        self.queue.append((request_id, list(prompt), time.monotonic()))
+        payload = snapshot if snapshot is not None else list(prompt)
+        self.queue.append((request_id, payload, time.monotonic()))
         self._m_queue.set(len(self.queue))
+
+    def cancel(self, request_id) -> bool:
+        """Cancel a request: queued -> removed from the queue; in
+        flight -> the slot is released and its pages freed. Nothing is
+        reported through ``finished()`` or ``on_complete``. Returns
+        False for an unknown (or already finished) id — cancellation
+        racing completion is a benign no-op, which is exactly what the
+        router's timeout/retry path needs."""
+        for i, entry in enumerate(self.queue):
+            if entry[0] == request_id:
+                self.queue.pop(i)
+                self._m_queue.set(len(self.queue))
+                self._m_cancel.inc()
+                return True
+        for slot, s in enumerate(self.slots):
+            if s is not None and s[0] == request_id:
+                self._release(slot)
+                self._m_cancel.inc()
+                return True
+        return False
 
     def _admit(self) -> None:
         for slot in range(self.max_batch):
             if self.slots[slot] is not None or not self.queue:
                 continue
-            rid, prompt, t_submit = self.queue[0]
+            rid, payload, t_submit = self.queue[0]
+            if isinstance(payload, KVSnapshot):
+                if not self._admit_snapshot(slot, rid, payload,
+                                            t_submit):
+                    break                 # admit in arrival order only
+                continue
+            prompt = payload
             bucket = min(self._bucket(len(prompt)), self.max_prompt)
             pages_needed = self._need_pages(len(prompt))
             if pages_needed > self.cache.pages_free:
@@ -943,24 +1097,183 @@ class ContinuousBatcher:
             # TTFT = queue wait + prefill, closed by the readback above
             self._m_ttft.observe(time.monotonic() - t_submit)
             self._m_admit.inc()
-            self.slots[slot] = (rid, len(prompt), [tok0])
+            self.slots[slot] = (rid, list(prompt), [tok0])
             self.lengths[slot] = len(prompt)
             self.last[slot] = tok0
+            if self.on_prefill is not None:
+                # fired BEFORE any decode write lands in the partial
+                # page, so a captured snapshot is prefix-clean
+                try:
+                    self.on_prefill(rid, list(prompt),
+                                    functools.partial(self._export_slot,
+                                                      slot))
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "on_prefill hook failed for %r", rid)
             if self.eos_id is not None and tok0 == self.eos_id:
                 self._retire(slot)
 
-    def _retire(self, slot: int) -> None:
-        rid, _, toks = self.slots[slot]
-        if self.eos_id is not None and self.eos_id in toks:
-            toks = toks[:toks.index(self.eos_id) + 1]
-        self._done.append((rid, toks[:self.max_new]))
+    def _admit_snapshot(self, slot: int, rid, snap: KVSnapshot,
+                        t_submit) -> bool:
+        """Adopt a :class:`KVSnapshot` into ``slot`` — allocation and
+        bookkeeping as a normal admit, but the KV pages are scattered
+        back from the snapshot and NO prefill runs (the measured
+        "prefill skip")."""
+        pages_needed = self._need_pages(len(snap.prompt))
+        if pages_needed > self.cache.pages_free:
+            return False
+        self.queue.pop(0)
+        pages = self.cache.alloc(pages_needed * self.page_size)
+        self._pages[slot] = pages
+        row = np.full((self.pages_per_slot,), self._scratch, np.int32)
+        row[:len(pages)] = pages
+        self.table[slot] = row
+        with trace.span("adopt", cat="serving",
+                        prompt_len=len(snap.prompt),
+                        n_cached=snap.n_cached, n_pages=snap.n_pages):
+            self._adopt_kv(pages, snap)
+        # TTFT for an adopted request is queue wait alone: its first
+        # token arrived with the snapshot (prefill was paid elsewhere —
+        # or skipped entirely on a prefix-cache hit)
+        self._m_ttft.observe(time.monotonic() - t_submit)
+        self._m_admit.inc()
+        self._m_skips.inc()
+        got = list(snap.emitted)
+        self.slots[slot] = (rid, list(snap.prompt), got)
+        self.lengths[slot] = snap.n_cached
+        self.last[slot] = snap.last_token
+        hit_eos = (self.eos_id is not None
+                   and self.eos_id in got[:self.max_new])
+        if hit_eos or len(got) >= self.max_new:
+            self._retire(slot)        # migrated right at the finish line
+        return True
+
+    def _adopt_kv(self, pages, snap: KVSnapshot) -> None:
+        idx = jnp.asarray(np.asarray(pages[:snap.n_pages], np.int32))
+        kp, vp = list(self.cache.kp), list(self.cache.vp)
+        for li, (k, v) in enumerate(snap.kv):
+            kp[li] = _scatter_pages(kp[li], idx, jnp.asarray(k))
+            vp[li] = _scatter_pages(vp[li], idx, jnp.asarray(v))
+        self.cache.kp, self.cache.vp = tuple(kp), tuple(vp)
+
+    def _export_kv(self, pages, n_cached: int):
+        """Gather the pages covering ``n_cached`` tokens to host in ONE
+        packed readback. The exported page count is bucketed (next
+        power of two of the token count, clamped to the allocation) so
+        gather shapes stay O(log max_len) per pool geometry."""
+        n_exp = min(-(-self._bucket(n_cached) // self.page_size),
+                    len(pages))
+        idx = jnp.asarray(np.asarray(pages[:n_exp], np.int32))
+        kvs = [(self.cache.kp[li][idx], self.cache.vp[li][idx])
+               for li in range(self.cache.num_layers)]
+        # deliberate sync: the snapshot IS a host artifact; one packed
+        # readback for all layers (jaxlint JX1's sanctioned shape)
+        return jax.device_get(kvs)
+
+    def _export_slot(self, slot: int) -> KVSnapshot:
+        rid, prompt, got = self.slots[slot]
+        n_cached = int(self.lengths[slot])
+        with trace.span("export", cat="serving", prompt_len=len(prompt),
+                        n_cached=n_cached,
+                        host_sync="packed KV page readback"):
+            kv = self._export_kv(self._pages[slot], n_cached)
+        self._m_export.inc()
+        return KVSnapshot(prompt, n_cached, kv, int(self.last[slot]),
+                          got, self.page_size)
+
+    def export_request(self, request_id) -> KVSnapshot:
+        """Export one IN-FLIGHT request for handoff: gathers its KV
+        pages to host, frees the slot, and returns the snapshot —
+        ``submit(rid, snapshot=...)`` on another identically configured
+        batcher resumes it mid-decode, bitwise. Queued requests cannot
+        be exported (there is nothing cached yet — ``pop_queued`` and
+        resubmit instead); raises KeyError for unknown ids."""
+        for slot, s in enumerate(self.slots):
+            if s is not None and s[0] == request_id:
+                snap = self._export_slot(slot)
+                self._release(slot)
+                return snap
+        raise KeyError(f"request {request_id!r} is not in flight")
+
+    def export_requests(self) -> list:
+        """Export EVERY in-flight request (drain migration): returns
+        ``[(request_id, KVSnapshot), ...]`` and leaves all slots
+        free."""
+        out = []
+        for slot, s in enumerate(self.slots):
+            if s is not None:
+                out.append((s[0], self._export_slot(slot)))
+                self._release(slot)
+        return out
+
+    def pop_queued(self) -> list:
+        """Remove and return every still-QUEUED entry as
+        ``[(request_id, prompt_or_snapshot), ...]`` — on drain the
+        router re-dispatches these to the surviving replicas."""
+        out = [(rid, payload) for rid, payload, _ in self.queue]
+        self.queue = []
+        self._m_queue.set(0)
+        return out
+
+    def prefill_only(self, request_id, prompt) -> KVSnapshot:
+        """Run ONLY the prefill for ``prompt`` and hand the resulting
+        KV back as a :class:`KVSnapshot`; the pages are freed again
+        before returning, so this batcher keeps nothing. The
+        disaggregation primitive: a long prompt prefills on a
+        designated/low-load replica and the snapshot is adopted by a
+        decode replica, whose decode bursts never stall behind it."""
+        if len(prompt) > self.max_prompt:
+            raise ValueError(f"prompt of {len(prompt)} tokens exceeds "
+                             f"max_prompt {self.max_prompt}")
+        bucket = min(self._bucket(len(prompt)), self.max_prompt)
+        n_table = -(-bucket // self.page_size)
+        n_real = min(n_table, -(-len(prompt) // self.page_size))
+        pages = self.cache.alloc(n_real * self.page_size)
+        try:
+            row = np.full((n_table,), self._scratch, np.int32)
+            row[:len(pages)] = pages
+            padded = np.ones((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            with trace.span("prefill_only", cat="serving", bucket=bucket,
+                            prompt_len=len(prompt),
+                            host_sync="first-token readback"):
+                first, _ = self._prefill_fn(
+                    self.model, self.cache, row[None, :], padded,
+                    lengths=np.asarray([len(prompt)], np.int32))
+                # deliberate sync: the first token rides the snapshot
+                tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
+            kv = self._export_kv(pages, len(prompt))
+            self._m_export.inc()
+        finally:
+            self.cache.free(pages)
+        return KVSnapshot(prompt, len(prompt), kv, tok0, [tok0],
+                          self.page_size)
+
+    def _release(self, slot: int) -> None:
+        """Free a slot's pages and reset its row — no result
+        recorded (shared by retire / cancel / export)."""
         self.cache.free(self._pages[slot])
         self._pages[slot] = None
         self.slots[slot] = None
         self.table[slot] = self._scratch
         self.lengths[slot] = 0
         self.last[slot] = 1
+
+    def _retire(self, slot: int) -> None:
+        rid, _, toks = self.slots[slot]
+        if self.eos_id is not None and self.eos_id in toks:
+            toks = toks[:toks.index(self.eos_id) + 1]
+        result = toks[:self.max_new]
+        self._done.append((rid, result))
+        self._release(slot)
         self._m_retire.inc()
+        if self.on_complete is not None:
+            # a crashing hook must not take the step loop down with it
+            try:
+                self.on_complete(rid, result)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "on_complete hook failed for %r", rid)
 
     def _resolve_burst(self, burst: int | None) -> int:
         """``None`` -> the largest default the construction allows
@@ -1005,10 +1318,10 @@ class ContinuousBatcher:
         self._m_tokens.inc(len(active) * burst)
         self.lengths = np.asarray(new_len, np.int32).copy()
         for i in active:
-            rid, plen, got = self.slots[i]
+            rid, prompt, got = self.slots[i]
             got.extend(int(t) for t in toks[i])
             self.last[i] = int(toks[i, -1])
-            self.slots[i] = (rid, plen, got)
+            self.slots[i] = (rid, prompt, got)
             hit_eos = (self.eos_id is not None
                        and self.eos_id in got[:self.max_new])
             if hit_eos or len(got) >= self.max_new:

@@ -21,6 +21,9 @@ Endpoints (GET):
   ``kind="liveness"`` checks) — 200 ok / 503 failing, JSON body.
 - ``/readyz``         readiness checks (``kind="readiness"``) — the
   load-balancer gate. A batcher that cannot admit reports not-ready.
+  Both probe endpoints accept ``?check=NAME[,NAME...]`` to gate on a
+  subset — the per-replica /readyz when one process hosts N serving
+  replicas (``serving_replica_<name>`` checks, docs/SERVING.md).
 
 Health checks are pluggable: ``default_health().register(name, fn,
 kind=...)`` where ``fn() -> (ok, detail)``. The optimizers register a
@@ -96,13 +99,31 @@ class HealthRegistry:
             out = [c for c in out if c.kind == kind]
         return out
 
-    def run(self, kind: str) -> tuple[bool, dict]:
-        """Run every check of ``kind``. With none registered the
-        verdict is ok — an empty process that answers HTTP is alive,
-        and ready-by-default matches a component-free harness."""
+    def run(self, kind: str, names=None) -> tuple[bool, dict]:
+        """Run every check of ``kind`` (optionally restricted to
+        ``names`` — the per-replica /readyz gate: one process serving N
+        batcher replicas answers for each one separately). With none
+        registered the verdict is ok — an empty process that answers
+        HTTP is alive, and ready-by-default matches a component-free
+        harness. A requested name with no registered check reports
+        failing: a load balancer probing a replica that never came up
+        must not route to it."""
         results = {}
         ok = True
-        for c in self.checks(kind):
+        checks = self.checks(kind)
+        if names is not None:
+            want = set(names)
+            by_name = {c.name: c for c in checks}
+            checks = []
+            for n in sorted(want):
+                c = by_name.get(n)
+                if c is None:
+                    ok = False
+                    results[n] = {"ok": False,
+                                  "detail": "no such check registered"}
+                else:
+                    checks.append(c)
+        for c in checks:
             c_ok, detail = c.run()
             ok = ok and c_ok
             results[c.name] = {"ok": c_ok, "detail": detail}
@@ -185,7 +206,7 @@ class MetricsServer:
     # -- endpoint bodies (handler-independent, unit-testable) --
     def render(self, path: str) -> tuple[int, str, bytes]:
         """(status, content_type, body) for a request path."""
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if path == "/metrics":
             return (200, "text/plain; version=0.0.4; charset=utf-8",
                     self.registry.expose().encode("utf-8"))
@@ -197,7 +218,16 @@ class MetricsServer:
                     json.dumps(self.tracer.to_dict()).encode("utf-8"))
         if path in ("/healthz", "/readyz"):
             kind = "liveness" if path == "/healthz" else "readiness"
-            ok, results = self.health.run(kind)
+            # ?check=NAME[,NAME...] (repeatable) narrows the verdict to
+            # the named checks — the per-replica LB gate when one
+            # process hosts N serving replicas (docs/SERVING.md)
+            names = None
+            if query:
+                from urllib.parse import parse_qs
+                picked = [n for v in parse_qs(query).get("check", [])
+                          for n in v.split(",") if n]
+                names = picked or None
+            ok, results = self.health.run(kind, names)
             body = json.dumps({"status": "ok" if ok else "failing",
                                "kind": kind, "checks": results},
                               sort_keys=True).encode("utf-8")

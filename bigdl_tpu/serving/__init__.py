@@ -1,0 +1,46 @@
+"""bigdl_tpu.serving — the production serving plane.
+
+The multi-replica front end over the continuous-batching stack
+(``models/transformer/serving.py``): ROADMAP item 1, the gap between a
+single ``ContinuousBatcher`` and a service (BigDL 2.0's end-to-end
+pipeline-to-serving story, arXiv:2204.01715). Four modules:
+
+- ``slo``           — :class:`SLOConfig` targets, :class:`ReplicaStats`,
+  the admission predicate and histogram-percentile helpers.
+- ``prefix_cache``  — :class:`PrefixCache`, the token-prefix -> retained
+  KV snapshot index behind sticky routing and prefill skips.
+- ``replica_pool``  — :class:`Replica` / :class:`ReplicaPool`, N batcher
+  step loops on daemon driver threads with per-replica registries and
+  health checks.
+- ``router``        — :class:`Router`, SLO-aware placement, prefix
+  reuse, prefill/decode disaggregation, bounded overflow +
+  :class:`RouterSaturated` load-shedding, and ``drain()`` for rolling
+  restarts.
+
+Quick start::
+
+    pool = ReplicaPool(model, 2, max_batch=4, num_pages=128,
+                       page_size=16, max_new_tokens=64)
+    router = Router(pool, slo=SLOConfig(long_prefill_tokens=512))
+    router.submit("req-0", prompt_tokens)
+    router.wait_all()
+    results = dict(router.finished())
+    router.close(); pool.close()
+
+HOST-ONLY CONTRACT: nothing in this package imports jax at module top
+level (jaxlint JX5) — the router is host orchestration; all device
+work happens inside the batchers it drives. docs/SERVING.md covers
+architecture, SLO knobs, and the drain/rolling-restart runbook.
+"""
+from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
+from bigdl_tpu.serving.replica_pool import (ACTIVE, DRAINING, STOPPED,
+                                            Replica, ReplicaPool)
+from bigdl_tpu.serving.router import Router, RouterSaturated
+from bigdl_tpu.serving.slo import (ReplicaStats, SLOConfig, admissible,
+                                   load_score, merge_snapshots,
+                                   percentile)
+
+__all__ = ["SLOConfig", "ReplicaStats", "admissible", "load_score",
+           "percentile", "merge_snapshots", "PrefixCache",
+           "PrefixEntry", "Replica", "ReplicaPool", "ACTIVE",
+           "DRAINING", "STOPPED", "Router", "RouterSaturated"]

@@ -1,0 +1,141 @@
+"""Prefix/session KV-cache index: token prefix -> (replica, retained
+KV snapshot).
+
+Repeated system prompts are the serving workload's common case; without
+an index every resubmission re-pays the full prefill. The router
+captures a :class:`~bigdl_tpu.models.transformer.serving.KVSnapshot`
+right after a prompt's first prefill (the batcher's ``on_prefill`` hook
+fires before any decode write lands in the partial page, so the copy is
+prefix-clean) and stores it here keyed by the token sequence. A later
+request with the SAME prompt adopts the snapshot instead of prefilling
+— the measured "prefill skip" (``serving_prefill_skips_total`` on the
+adopting replica, ``router_prefix_hits_total`` at the router).
+
+Entries remember the replica that produced them only as a STICKY
+ROUTING PREFERENCE; the snapshot itself is a host-side copy, so a hit
+can be adopted by any identically configured replica — which is what
+lets prefix reuse survive a drain/rolling restart.
+
+Correctness: the key is the exact token tuple and ``lookup`` verifies
+it (dict hashing plus full equality), because adopting the wrong KV
+would silently change outputs. Eviction is LRU with both an entry and a
+byte budget (snapshots hold real page data).
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5); snapshots are
+numpy arrays produced by the batcher's packed export.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+__all__ = ["PrefixCache", "PrefixEntry"]
+
+
+class PrefixEntry:
+    """One retained prefix: the snapshot plus its sticky-replica
+    preference and hit count."""
+
+    __slots__ = ("prompt", "replica", "snapshot", "hits")
+
+    def __init__(self, prompt, replica, snapshot):
+        self.prompt = tuple(prompt)
+        self.replica = replica
+        self.snapshot = snapshot
+        self.hits = 0
+
+
+class PrefixCache:
+    """LRU map of token prefix -> :class:`PrefixEntry`.
+
+    ``min_tokens`` gates what is worth retaining: short prompts
+    re-prefill faster than their snapshot round-trips. ``max_bytes``
+    bounds the host memory the retained KV may hold (oldest evicted
+    first)."""
+
+    def __init__(self, capacity: int = 64, min_tokens: int = 16,
+                 max_bytes: int | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.min_tokens = int(min_tokens)
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def lookup(self, prompt) -> PrefixEntry | None:
+        """The entry for EXACTLY ``prompt``, refreshing its LRU
+        position — or None."""
+        key = tuple(prompt)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            e.hits += 1
+            self.hits += 1
+            return e
+
+    def put(self, prompt, replica, snapshot) -> bool:
+        """Retain ``snapshot`` for ``prompt``; returns whether it was
+        kept (prompts under ``min_tokens`` are not worth it). A repeat
+        put refreshes the entry (latest snapshot/replica wins)."""
+        key = tuple(prompt)
+        if len(key) < self.min_tokens:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.snapshot.nbytes
+            e = PrefixEntry(key, replica, snapshot)
+            self._entries[key] = e
+            self._bytes += snapshot.nbytes
+            while len(self._entries) > self.capacity or (
+                    self.max_bytes is not None
+                    and self._bytes > self.max_bytes
+                    and len(self._entries) > 1):
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.snapshot.nbytes
+            return True
+
+    def invalidate(self, prompt) -> bool:
+        with self._lock:
+            e = self._entries.pop(tuple(prompt), None)
+            if e is not None:
+                self._bytes -= e.snapshot.nbytes
+            return e is not None
+
+    def forget_replica(self, name) -> int:
+        """Clear the sticky preference for a drained/retired replica.
+        Snapshots stay valid (host copies) — only the routing hint is
+        dropped. Returns how many entries pointed there."""
+        n = 0
+        with self._lock:
+            for e in self._entries.values():
+                if e.replica == name:
+                    e.replica = None
+                    n += 1
+        return n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits, "misses": self.misses}

@@ -1,0 +1,283 @@
+"""Replica threads: N ``ContinuousBatcher`` step loops behind one pool.
+
+A :class:`Replica` owns one batcher (its own metric registry, its own
+KV page pool — conceptually one device/slice), a re-entrant lock that
+serializes every batcher touch, and a daemon driver thread that keeps
+calling ``step()`` while work is queued. :class:`ReplicaPool` builds N
+identically configured replicas over a shared (read-only) model and
+manages their lifecycle.
+
+Per-replica registries are the isolation the router needs: gauges like
+``serving_queue_depth`` are name-keyed, so two batchers writing one
+process-wide registry would overwrite each other. Live load (queue
+depth, free slots, page pressure) is read straight off batcher host
+state under the replica lock; latency percentiles come from the
+replica-local histograms (``Replica.histogram_snapshot``), and the
+router republishes the fleet view into the process registry with a
+``replica`` label.
+
+Health: every replica answers two checks in the (shared) health
+registry — ``serving_batcher_<name>`` (the batcher's own
+admitting/saturated readiness) and ``serving_replica_<name>``
+(lifecycle: flips not-ready the moment a drain begins, which is the
+load-balancer signal for rolling restarts). The ``MetricsServer``'s
+``/readyz?check=serving_replica_<name>`` filter gates one replica
+without consulting the others.
+
+Thread contract: the driver thread is the only caller of ``step()``;
+router threads call ``submit``/``cancel``/``export``/``stats`` under
+the same lock. A ``step()`` in flight simply delays those calls by one
+burst. Locks are re-entrant so batcher hooks (``on_complete``) may
+fire router code on the driver thread.
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5). The batcher class
+is imported lazily inside :class:`ReplicaPool` construction, so this
+module stays importable in jax-free tooling.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from bigdl_tpu.observability.exporter import default_health
+from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.serving.slo import ReplicaStats
+
+__all__ = ["Replica", "ReplicaPool", "ACTIVE", "DRAINING", "STOPPED"]
+
+ACTIVE = "active"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+_EMPTY_SNAPSHOT = {"buckets": {}, "sum": 0.0, "count": 0}
+
+
+class Replica:
+    """One batcher + driver thread. Construct via :class:`ReplicaPool`
+    (which wires registries and health names) or directly for tests."""
+
+    def __init__(self, name: str, batcher, *, registry, burst=None,
+                 health=None, poll_interval: float = 0.005):
+        self.name = str(name)
+        self.batcher = batcher
+        self.registry = registry
+        self.lock = threading.RLock()
+        self._burst = burst
+        self._poll = float(poll_interval)
+        self._state = ACTIVE
+        self._stop = False
+        self._wake = threading.Event()
+        self._health = health if health is not None else default_health()
+        self._health.register(f"serving_replica_{self.name}",
+                              self._ready, kind="readiness")
+        self._thread = threading.Thread(
+            target=self._run, name=f"bigdl-serving-{self.name}",
+            daemon=True)
+        self._started = False
+
+    # -- lifecycle --
+    def start(self) -> "Replica":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def _run(self):
+        import logging
+        log = logging.getLogger(__name__)
+        while not self._stop:
+            stepped = 0
+            try:
+                with self.lock:
+                    if not self._stop and not self.batcher.idle:
+                        stepped = self.batcher.step(self._burst)
+            except Exception:
+                # a crashing step must not silently kill the driver —
+                # log and keep serving (the health check reports the
+                # batcher's own admitting/saturated verdict)
+                log.exception("replica %s step failed", self.name)
+                stepped = 0
+            if not stepped:
+                # idle, or queued work that cannot admit yet: park
+                # until a submit wakes us (or the poll tick re-checks)
+                self._wake.wait(self._poll)
+                self._wake.clear()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the driver thread and unregister health checks (a dead
+        replica must stop answering for the process)."""
+        self._stop = True
+        self._wake.set()
+        if self._started:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"replica {self.name} driver did not stop in "
+                    f"{timeout}s")
+        with self.lock:
+            self._state = STOPPED
+        self._health.unregister(f"serving_replica_{self.name}")
+        self._health.unregister(self.batcher.health_name)
+
+    # -- state --
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def drain_begin(self) -> None:
+        """Stop admissions: lifecycle readiness flips immediately; the
+        driver keeps stepping so in-flight sequences finish."""
+        with self.lock:
+            if self._state == STOPPED:
+                raise RuntimeError(f"replica {self.name} is stopped")
+            self._state = DRAINING
+
+    def resume(self) -> None:
+        with self.lock:
+            if self._state == STOPPED:
+                raise RuntimeError(f"replica {self.name} is stopped")
+            self._state = ACTIVE
+        self._wake.set()
+
+    def _ready(self):
+        # lock-free racy read: a health probe must never block behind
+        # a decode burst (HealthCheck.run already fences crashes)
+        if self._state != ACTIVE:
+            return False, f"replica {self.name} is {self._state}"
+        ok, detail = self.batcher._ready()
+        return ok, f"{self.name}: {detail}"
+
+    # -- request plane (router-facing; all under the replica lock) --
+    def submit(self, request_id, prompt=None, *, snapshot=None) -> None:
+        with self.lock:
+            if self._state != ACTIVE:
+                raise RuntimeError(
+                    f"replica {self.name} is {self._state}: not "
+                    "admitting")
+            self.batcher.submit(request_id, prompt, snapshot=snapshot)
+        self._wake.set()
+
+    def cancel(self, request_id) -> bool:
+        with self.lock:
+            return self.batcher.cancel(request_id)
+
+    def prefill_only(self, request_id, prompt):
+        """Disaggregation entry: run a prefill here (the lock means it
+        interleaves with THIS replica's bursts, never a decode
+        replica's) and hand the KV snapshot back."""
+        with self.lock:
+            return self.batcher.prefill_only(request_id, prompt)
+
+    def export_requests(self) -> list:
+        with self.lock:
+            return self.batcher.export_requests()
+
+    def pop_queued(self) -> list:
+        with self.lock:
+            return self.batcher.pop_queued()
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until the batcher has nothing queued or in flight."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.batcher.idle:
+                    return True
+            time.sleep(self._poll)
+        with self.lock:
+            return self.batcher.idle
+
+    # -- telemetry --
+    def histogram_snapshot(self, name: str) -> dict:
+        m = self.registry.get(name)
+        return m.snapshot() if m is not None else dict(_EMPTY_SNAPSHOT)
+
+    def stats(self) -> ReplicaStats:
+        from bigdl_tpu.serving.slo import percentile
+        with self.lock:
+            b = self.batcher
+            free_slots = sum(s is None for s in b.slots)
+            queue_depth = len(b.queue)
+            pages_free = b.cache.pages_free
+            util = 1.0 - pages_free / b.cache.num_pages
+            skips = int(b._m_skips.value())
+            state = self._state
+        ttft = self.histogram_snapshot("serving_ttft_seconds")
+        dec = self.histogram_snapshot("serving_decode_token_seconds")
+        return ReplicaStats(
+            name=self.name, state=state, queue_depth=queue_depth,
+            active_slots=b.max_batch - free_slots,
+            free_slots=free_slots, pages_free=pages_free,
+            kv_utilization=util,
+            ttft_p50=percentile(ttft, 0.5),
+            ttft_p99=percentile(ttft, 0.99),
+            decode_token_p99=percentile(dec, 0.99),
+            prefill_skips=skips)
+
+
+class ReplicaPool:
+    """N identically configured batcher replicas over one model.
+
+    ``batcher_kwargs`` forwards to ``ContinuousBatcher`` (``max_batch``,
+    ``num_pages``, ``page_size``, ``max_new_tokens``, ``max_burst``,
+    ``eos_id``); identical geometry across replicas is what makes KV
+    snapshots portable between them (the batcher validates on adopt).
+    Each replica gets a private :class:`MetricRegistry` and health
+    checks named per replica in the SHARED health registry."""
+
+    def __init__(self, model, n_replicas: int = 2, *, names=None,
+                 burst=None, health=None, start: bool = True,
+                 **batcher_kwargs):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        # lazy: keeps this module importable without jax (JX5 contract)
+        from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+        if names is None:
+            names = [f"r{i}" for i in range(n_replicas)]
+        if len(names) != n_replicas or len(set(names)) != n_replicas:
+            raise ValueError(f"need {n_replicas} distinct names, got "
+                             f"{names}")
+        self._health = health if health is not None else default_health()
+        self.replicas: dict[str, Replica] = {}
+        for name in names:
+            reg = MetricRegistry()
+            batcher = ContinuousBatcher(
+                model, registry=reg, health=self._health,
+                health_name=f"serving_batcher_{name}", **batcher_kwargs)
+            self.replicas[name] = Replica(name, batcher, registry=reg,
+                                          burst=burst,
+                                          health=self._health)
+        if start:
+            self.start()
+
+    @property
+    def names(self) -> list[str]:
+        return list(self.replicas)
+
+    def __getitem__(self, name: str) -> Replica:
+        return self.replicas[name]
+
+    def __iter__(self):
+        return iter(self.replicas.values())
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def start(self) -> "ReplicaPool":
+        for r in self.replicas.values():
+            r.start()
+        return self
+
+    def stats(self) -> list[ReplicaStats]:
+        return [r.stats() for r in self.replicas.values()]
+
+    def close(self, timeout: float = 10.0) -> None:
+        for r in self.replicas.values():
+            r.stop(timeout)
+
+    def __enter__(self) -> "ReplicaPool":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

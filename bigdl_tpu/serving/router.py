@@ -1,0 +1,466 @@
+"""SLO-aware request router over a :class:`ReplicaPool`.
+
+The front door that turns the single-replica serving stack into a
+service (ROADMAP item 1; BigDL 2.0's pipeline-to-serving story,
+arXiv:2204.01715). One ``submit()`` call per request; the router
+
+- **places** it on the best admissible replica — admission gates on
+  each replica's live queue depth, KV-page utilization and observed
+  TTFT/decode p99 vs the :class:`SLOConfig` targets
+  (``slo.admissible``), ranking survivors by ``slo.load_score``;
+- **reuses prefixes**: a prompt seen before routes sticky to the
+  replica that served it and ADOPTS the retained KV snapshot instead
+  of re-prefilling (``router_prefix_hits_total`` at the router,
+  ``serving_prefill_skips_total`` on the adopting replica);
+- **disaggregates** long prefills: prompts past
+  ``slo.long_prefill_tokens`` prefill on the designated (or
+  lowest-load) replica via ``prefill_only`` and the KV snapshot is
+  handed to a different decode replica, so decode bursts never stall
+  behind a long prompt;
+- **overflows** to a bounded router-level pending queue when no
+  replica admits, and raises :class:`RouterSaturated` past
+  ``slo.max_pending`` (explicit load-shedding);
+- **drains** replicas for rolling restarts: ``drain(name)`` stops
+  admissions (the replica's ``/readyz`` check flips immediately),
+  re-dispatches its still-queued requests to survivors, then either
+  lets in-flight sequences finish or — ``migrate=True`` — exports
+  their KV mid-decode and resumes them elsewhere, bitwise.
+
+Results fan in through the batchers' ``on_complete`` hooks into one
+``finished()`` stream; every accepted request completes exactly once
+(no drops, no duplicates — test-pinned).
+
+Locking: ``_state_lock`` guards only the router's own dicts and is
+never held while a replica lock is being acquired; replica driver
+threads call back into ``_on_complete`` holding their replica lock and
+take ``_state_lock`` briefly. That one-way order (replica -> state) is
+what makes the plane deadlock-free. The pending queue is flushed by a
+single dispatcher thread, so batcher-level arrival order is preserved.
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — routing is pure
+host orchestration over the batcher API.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from bigdl_tpu.observability import trace
+from bigdl_tpu.observability.exporter import default_health
+from bigdl_tpu.observability.registry import default_registry
+from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.slo import (SLOConfig, admissible, load_score,
+                                   merge_snapshots, percentile)
+
+__all__ = ["Router", "RouterSaturated"]
+
+
+class RouterSaturated(RuntimeError):
+    """No replica admits and the router-level pending queue is full."""
+
+
+class Router:
+    """See module docstring. ``pool`` is a started
+    :class:`~bigdl_tpu.serving.replica_pool.ReplicaPool`; the router
+    takes over each batcher's ``on_complete``/``on_prefill`` hooks.
+
+    - ``prefill_replica``: name of the designated prefill replica for
+      disaggregation (default: pick the lowest-load admissible one per
+      request).
+    - ``capture_prefixes``: snapshot prompts >= the prefix cache's
+      ``min_tokens`` after their first prefill for later reuse.
+    - ``registry``/``health``: the process-wide fleet view — labeled
+      per-replica gauges, router counters, and the
+      ``serving_router`` readiness check (ready while >= 1 replica
+      admits).
+    """
+
+    def __init__(self, pool, *, slo: SLOConfig | None = None,
+                 prefix_cache: PrefixCache | None = None,
+                 registry=None, health=None, prefill_replica=None,
+                 capture_prefixes: bool = True):
+        self.pool = pool
+        self.slo = slo if slo is not None else SLOConfig()
+        self.prefix = (prefix_cache if prefix_cache is not None
+                       else PrefixCache())
+        self._capture = bool(capture_prefixes)
+        if prefill_replica is not None and \
+                prefill_replica not in pool.replicas:
+            raise ValueError(f"unknown prefill replica "
+                             f"{prefill_replica!r} (have {pool.names})")
+        self._prefill_name = prefill_replica
+
+        reg = default_registry() if registry is None else registry
+        self._m_requests = reg.counter(
+            "router_requests_total", "requests accepted by the router")
+        self._m_completed = reg.counter(
+            "router_completed_total", "requests completed and collected")
+        self._m_prefix_hits = reg.counter(
+            "router_prefix_hits_total",
+            "requests served from the prefix KV cache (prefill skipped)")
+        self._m_disagg = reg.counter(
+            "router_disagg_prefills_total",
+            "long prompts prefilled on one replica, decoded on another")
+        self._m_rejected = reg.counter(
+            "router_rejected_total",
+            "requests shed because router + replicas were saturated")
+        self._m_migrated = reg.counter(
+            "router_migrations_total",
+            "in-flight requests moved between replicas during drain")
+        self._m_pending = reg.gauge(
+            "router_pending_depth",
+            "requests waiting at the router for an admissible replica")
+        self._m_rq = reg.gauge(
+            "router_replica_queue_depth",
+            "per-replica batcher queue depth as last seen by the router",
+            labelnames=("replica",))
+        self._m_rutil = reg.gauge(
+            "router_replica_kv_utilization",
+            "per-replica KV page utilization as last seen by the router",
+            labelnames=("replica",))
+
+        self._health = health if health is not None else default_health()
+        self._health.register("serving_router", self._ready,
+                              kind="readiness")
+
+        # _state_lock guards the dicts below; NEVER held while taking a
+        # replica lock (see module docstring)
+        self._state_lock = threading.Lock()
+        self._inflight: dict = {}       # rid -> replica name | None
+        self._pending: deque = deque()  # (rid, payload, session)
+        self._results: deque = deque()
+        self._sessions: dict = {}       # session id -> replica name
+        self._closed = False
+
+        for name, rep in pool.replicas.items():
+            rep.batcher.on_complete = self._make_on_complete(name)
+            if self._capture:
+                rep.batcher.on_prefill = self._make_on_prefill(name)
+
+        self._pump_wake = threading.Event()
+        self._pump_thread = threading.Thread(
+            target=self._pump, name="bigdl-serving-router", daemon=True)
+        self._pump_thread.start()
+
+    # -- hooks (run on replica driver threads, replica lock held) --
+    def _make_on_complete(self, name):
+        def hook(rid, toks):
+            with self._state_lock:
+                self._inflight.pop(rid, None)
+                self._results.append((rid, list(toks)))
+            self._m_completed.inc()
+            self._pump_wake.set()
+        return hook
+
+    def _make_on_prefill(self, name):
+        def hook(rid, prompt, snapshot_fn):
+            if len(prompt) < self.prefix.min_tokens:
+                return
+            if self.prefix.lookup(prompt) is not None:
+                return          # already retained; skip the re-export
+            self.prefix.put(prompt, name, snapshot_fn())
+        return hook
+
+    # -- health --
+    def _ready(self):
+        n_ok = 0
+        for rep in self.pool:
+            # racy read by design: probes must not block on locks
+            if rep.state == "active" and rep.batcher._ready()[0]:
+                n_ok += 1
+        return (n_ok > 0,
+                f"{n_ok}/{len(self.pool)} replicas admitting")
+
+    # -- submission --
+    def submit(self, request_id, prompt, *, session=None):
+        """Accept one request (list of 1-based token ids). Returns the
+        replica name it was placed on, or ``None`` if it parked in the
+        router's pending queue (dispatched as soon as a replica
+        admits). Raises on duplicate in-flight ids and
+        :class:`RouterSaturated` past ``slo.max_pending``."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        prompt = list(prompt)
+        with self._state_lock:
+            if request_id in self._inflight:
+                raise ValueError(
+                    f"duplicate request_id {request_id!r}: still "
+                    "pending or in flight")
+            self._inflight[request_id] = None    # reserve
+        self._m_requests.inc()
+        try:
+            placed = self._dispatch(request_id, prompt, session)
+        except Exception:
+            with self._state_lock:
+                self._inflight.pop(request_id, None)
+            raise
+        if placed is None:
+            with self._state_lock:
+                if len(self._pending) >= self.slo.max_pending:
+                    self._inflight.pop(request_id, None)
+                    self._m_rejected.inc()
+                    raise RouterSaturated(
+                        f"no replica admits and {len(self._pending)} "
+                        f"requests already pending "
+                        f"(slo.max_pending={self.slo.max_pending})")
+                self._pending.append((request_id, prompt, session))
+                self._m_pending.set(len(self._pending))
+        return placed
+
+    def cancel(self, request_id) -> bool:
+        """Cancel wherever the request is: router pending queue, a
+        replica queue, or an in-flight slot. False if unknown/already
+        finished."""
+        with self._state_lock:
+            for i, (rid, _, _) in enumerate(self._pending):
+                if rid == request_id:
+                    del self._pending[i]
+                    self._m_pending.set(len(self._pending))
+                    self._inflight.pop(request_id, None)
+                    return True
+            owner = self._inflight.get(request_id)
+        if owner is not None and self.pool[owner].cancel(request_id):
+            with self._state_lock:
+                self._inflight.pop(request_id, None)
+            return True
+        return False
+
+    # -- placement --
+    def _fleet_stats(self) -> dict:
+        stats = {}
+        for rep in self.pool:
+            s = rep.stats()
+            stats[s.name] = s
+            self._m_rq.set(s.queue_depth, replica=s.name)
+            self._m_rutil.set(s.kv_utilization, replica=s.name)
+        return stats
+
+    def _dispatch(self, rid, payload, session):
+        """Try to place ``payload`` (a prompt list, or a KVSnapshot
+        when re-dispatching drained/migrated work). Returns the replica
+        name or None when nothing admits right now."""
+        # prompts arrive as lists; anything else is a KV snapshot
+        is_prompt = isinstance(payload, list)
+        stats = self._fleet_stats()
+        cands = [s for s in stats.values()
+                 if admissible(s, self.slo)[0]]
+        with trace.span("route", cat="serving",
+                        prompt_len=len(payload) if is_prompt else
+                        len(payload.prompt),
+                        candidates=len(cands)):
+            if is_prompt:
+                hit = self.prefix.lookup(payload)
+                if hit is not None and cands:
+                    target = (hit.replica
+                              if hit.replica in {s.name for s in cands}
+                              else min(cands, key=load_score).name)
+                    self.pool[target].submit(rid, snapshot=hit.snapshot)
+                    self._m_prefix_hits.inc()
+                    self._place(rid, target, session)
+                    return target
+                if (len(payload) >= self.slo.long_prefill_tokens
+                        and len(cands) > 1):
+                    return self._dispatch_disaggregated(
+                        rid, payload, session, stats, cands)
+            if not cands:
+                return None
+            target = self._pick(cands, session)
+            if is_prompt:
+                self.pool[target].submit(rid, payload)
+            else:
+                self.pool[target].submit(rid, snapshot=payload)
+            self._place(rid, target, session)
+            return target
+
+    def _pick(self, cands, session) -> str:
+        if session is not None:
+            sticky = self._sessions.get(session)
+            if sticky is not None and any(s.name == sticky
+                                          for s in cands):
+                return sticky
+        return min(cands, key=load_score).name
+
+    def _place(self, rid, target, session) -> None:
+        with self._state_lock:
+            self._inflight[rid] = target
+            if session is not None:
+                self._sessions[session] = target
+
+    def _dispatch_disaggregated(self, rid, prompt, session, stats,
+                                cands):
+        """Prefill on the designated/lowest-load replica, decode on the
+        best OTHER candidate — a long prompt never parks a decode
+        replica's bursts behind its prefill."""
+        names = {s.name for s in cands}
+        if self._prefill_name is not None and self._prefill_name in names:
+            pre = self._prefill_name
+        else:
+            pre = min(cands, key=load_score).name
+        decode_cands = [s for s in cands if s.name != pre]
+        if not decode_cands:      # pre is the lone candidate
+            self.pool[pre].submit(rid, prompt)
+            self._place(rid, pre, session)
+            return pre
+        dec = self._pick(decode_cands, session)
+        try:
+            with trace.span("disagg prefill", cat="serving",
+                            prefill=pre, decode=dec,
+                            prompt_len=len(prompt)):
+                snap = self.pool[pre].prefill_only(rid, prompt)
+        except RuntimeError:
+            # transient page pressure on the prefill side: fall back
+            # to a plain placement rather than failing the request
+            target = self._pick(cands, session)
+            self.pool[target].submit(rid, prompt)
+            self._place(rid, target, session)
+            return target
+        self._m_disagg.inc()
+        if self._capture:
+            # long prompts are exactly the ones worth retaining
+            self.prefix.put(prompt, dec, snap)
+        self.pool[dec].submit(rid, snapshot=snap)
+        self._place(rid, dec, session)
+        return dec
+
+    # -- pending pump (single consumer preserves arrival order) --
+    def _pump(self):
+        while not self._closed:
+            self._pump_wake.wait(0.02)
+            self._pump_wake.clear()
+            try:
+                self._flush_pending()
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "router pending flush failed")
+
+    def _flush_pending(self):
+        while True:
+            with self._state_lock:
+                if not self._pending:
+                    self._m_pending.set(0)
+                    return
+                rid, payload, session = self._pending[0]
+            if self._dispatch(rid, payload, session) is None:
+                return            # still saturated; next wake retries
+            with self._state_lock:
+                self._pending.popleft()
+                self._m_pending.set(len(self._pending))
+
+    # -- results --
+    def finished(self) -> list:
+        """Pop completed ``(request_id, tokens)`` pairs (every accepted
+        request appears exactly once)."""
+        with self._state_lock:
+            out = list(self._results)
+            self._results.clear()
+        return out
+
+    @property
+    def inflight_count(self) -> int:
+        with self._state_lock:
+            return len(self._inflight)
+
+    @property
+    def pending_count(self) -> int:
+        with self._state_lock:
+            return len(self._pending)
+
+    def wait_all(self, timeout: float = 120.0) -> None:
+        """Block until every accepted request has completed."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._state_lock:
+                busy = len(self._inflight) + len(self._pending)
+            if not busy:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{busy} requests still outstanding after "
+                    f"{timeout}s")
+            time.sleep(0.005)
+
+    # -- drain / rolling restart --
+    def drain(self, name: str, *, migrate: bool = False,
+              timeout: float = 120.0) -> dict:
+        """Take replica ``name`` out of rotation: admissions stop and
+        its ``serving_replica_<name>`` readiness flips immediately;
+        still-queued requests re-dispatch to the survivors; in-flight
+        sequences either finish here (default) or — ``migrate=True`` —
+        export their KV mid-decode and resume on other replicas,
+        bitwise. Returns a summary dict. ``resume(name)`` puts the
+        replica back."""
+        rep = self.pool[name]
+        with trace.span("drain", cat="serving", replica=name,
+                        migrate=migrate):
+            rep.drain_begin()
+            requeued = rep.pop_queued()
+            for rid, payload in requeued:
+                self._requeue(rid, payload)
+            migrated = []
+            if migrate:
+                migrated = rep.export_requests()
+                for rid, snap in migrated:
+                    self._m_migrated.inc()
+                    self._requeue(rid, snap)
+            elif not rep.wait_idle(timeout):
+                raise TimeoutError(
+                    f"replica {name} did not drain in {timeout}s")
+            self.prefix.forget_replica(name)
+            with self._state_lock:
+                dead_sessions = [k for k, v in self._sessions.items()
+                                 if v == name]
+                for k in dead_sessions:
+                    del self._sessions[k]
+        self._pump_wake.set()
+        return {"replica": name, "requeued": len(requeued),
+                "migrated": len(migrated)}
+
+    def _requeue(self, rid, payload) -> None:
+        with self._state_lock:
+            self._inflight[rid] = None
+            self._pending.append((rid, payload, None))
+            self._m_pending.set(len(self._pending))
+
+    def resume(self, name: str) -> None:
+        self.pool[name].resume()
+        self._pump_wake.set()
+
+    # -- fleet latency view (bench serving rows) --
+    def latency_summary(self) -> dict:
+        """Fleet-wide latency percentiles: per-replica histograms
+        merged by bucket (conservative upper-bound estimates)."""
+        ttft = merge_snapshots(
+            r.histogram_snapshot("serving_ttft_seconds")
+            for r in self.pool)
+        dec = merge_snapshots(
+            r.histogram_snapshot("serving_decode_token_seconds")
+            for r in self.pool)
+        return {
+            "ttft_p50_s": percentile(ttft, 0.5),
+            "ttft_p99_s": percentile(ttft, 0.99),
+            "ttft_count": ttft["count"],
+            "decode_token_p50_s": percentile(dec, 0.5),
+            "decode_token_p99_s": percentile(dec, 0.99),
+            "prefix_hits": int(self._m_prefix_hits.value()),
+            "disagg_prefills": int(self._m_disagg.value()),
+        }
+
+    # -- lifecycle --
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the dispatcher and unregister the router health check.
+        The pool is NOT closed (the owner that started it closes it)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pump_wake.set()
+        self._pump_thread.join(timeout)
+        self._health.unregister("serving_router")
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,158 @@
+"""SLO model for the serving router: targets, live replica stats, and
+the admission predicate.
+
+The router's placement decisions are driven by three signal families
+the batchers already export (PR 1/4 observability): live queue depth /
+slot occupancy / KV-page utilization (read directly off host state),
+and OBSERVED latency percentiles (TTFT, per-token decode) estimated
+from the per-replica registry histograms. :class:`SLOConfig` names the
+knobs; :func:`admissible` turns one replica's :class:`ReplicaStats`
+into an admit/defer verdict with a human-readable reason (the same
+string surfaces in /readyz details and router logs).
+
+Percentiles come from cumulative histogram snapshots
+(``Histogram.snapshot()``), so an estimate is the smallest bucket upper
+bound covering the requested quantile — conservative (never
+under-reports) and mergeable across replicas by summing bucket counts
+(:func:`merge_snapshots`, used by the bench serving rows for
+fleet-wide p50/p99).
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — pure arithmetic
+over host state.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+__all__ = ["SLOConfig", "ReplicaStats", "percentile", "merge_snapshots",
+           "admissible", "load_score"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Serving objectives + admission limits.
+
+    - ``ttft_p99_s`` / ``decode_token_p99_s``: latency targets; a
+      replica whose OBSERVED p99 exceeds the target while it has a
+      backlog stops admitting (it is already failing its SLO — sending
+      more work makes every queued request later).
+    - ``max_queue_depth``: per-replica bound on requests waiting for a
+      slot.
+    - ``max_kv_utilization``: fraction of the KV page pool in use past
+      which a replica stops admitting (head-of-line admission would
+      stall behind page pressure anyway).
+    - ``long_prefill_tokens``: prompts at or past this length are
+      disaggregated — prefilled on a designated/low-load replica and
+      handed to a decode replica as a KV snapshot.
+    - ``max_pending``: router-level overflow queue bound; past it
+      ``submit`` raises ``RouterSaturated`` (load-shedding, not
+      unbounded buffering).
+    """
+
+    ttft_p99_s: float = 2.0
+    decode_token_p99_s: float = 1.0
+    max_queue_depth: int = 8
+    max_kv_utilization: float = 0.95
+    long_prefill_tokens: int = 256
+    max_pending: int = 1024
+
+    def __post_init__(self):
+        if self.ttft_p99_s <= 0 or self.decode_token_p99_s <= 0:
+            raise ValueError("latency targets must be positive")
+        if self.max_queue_depth < 0 or self.max_pending < 0:
+            raise ValueError("queue bounds must be >= 0")
+        if not 0.0 < self.max_kv_utilization <= 1.0:
+            raise ValueError(
+                f"max_kv_utilization must be in (0, 1], got "
+                f"{self.max_kv_utilization}")
+        if self.long_prefill_tokens < 1:
+            raise ValueError("long_prefill_tokens must be >= 1")
+
+
+@dataclasses.dataclass
+class ReplicaStats:
+    """One replica's live load + observed latency, as the router sees
+    it (``Replica.stats()``). Latency fields are ``None`` until the
+    replica has observations."""
+
+    name: str
+    state: str
+    queue_depth: int
+    active_slots: int
+    free_slots: int
+    pages_free: int
+    kv_utilization: float
+    ttft_p50: float | None = None
+    ttft_p99: float | None = None
+    decode_token_p99: float | None = None
+    prefill_skips: int = 0
+
+
+def percentile(snapshot: dict, q: float) -> float | None:
+    """Quantile ``q`` in (0, 1] from a cumulative histogram snapshot
+    (``{"buckets": {le: cumulative_count}, "count": n}``). Returns the
+    smallest bucket upper bound covering the quantile — a conservative
+    (never-under) estimate; ``None`` with no observations."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"q must be in (0, 1], got {q}")
+    n = int(snapshot.get("count", 0))
+    if n == 0:
+        return None
+    need = math.ceil(q * n)
+    for le, cum in snapshot.get("buckets", {}).items():
+        if cum >= need:
+            return float(le)
+    return math.inf
+
+
+def merge_snapshots(snapshots) -> dict:
+    """Sum cumulative histogram snapshots taken from IDENTICAL bucket
+    boundaries (true for any one metric name across replica
+    registries). The merge of cumulative counts is cumulative again, so
+    :func:`percentile` applies directly — fleet-wide p50/p99."""
+    out: dict = {"buckets": {}, "sum": 0.0, "count": 0}
+    for s in snapshots:
+        out["sum"] += float(s.get("sum", 0.0))
+        out["count"] += int(s.get("count", 0))
+        for le, cum in s.get("buckets", {}).items():
+            out["buckets"][le] = out["buckets"].get(le, 0) + cum
+    return out
+
+
+def admissible(stats: ReplicaStats, slo: SLOConfig) -> tuple[bool, str]:
+    """Should the router hand ``stats``'s replica one more request?
+
+    Gates, in order: replica lifecycle state; queue depth; KV page
+    pressure; then the latency SLOs — which only bite while the
+    replica has a backlog (``queue_depth > 0``): an idle replica whose
+    historical p99 is poor is still the fastest path for the next
+    request."""
+    if stats.state != "active":
+        return False, f"replica is {stats.state}"
+    if stats.queue_depth >= slo.max_queue_depth:
+        return False, (f"queue full ({stats.queue_depth} >= "
+                       f"{slo.max_queue_depth})")
+    if stats.kv_utilization >= slo.max_kv_utilization:
+        return False, (f"KV pool at {stats.kv_utilization:.0%} >= "
+                       f"{slo.max_kv_utilization:.0%}")
+    if stats.queue_depth > 0:
+        if stats.ttft_p99 is not None and stats.ttft_p99 > slo.ttft_p99_s:
+            return False, (f"observed TTFT p99 {stats.ttft_p99:.3g}s "
+                           f"over the {slo.ttft_p99_s:.3g}s SLO with a "
+                           "backlog")
+        if (stats.decode_token_p99 is not None
+                and stats.decode_token_p99 > slo.decode_token_p99_s):
+            return False, (f"observed decode p99 "
+                           f"{stats.decode_token_p99:.3g}s/token over "
+                           f"the {slo.decode_token_p99_s:.3g}s SLO "
+                           "with a backlog")
+    return True, "admitting"
+
+
+def load_score(stats: ReplicaStats) -> tuple:
+    """Ranking key for placement among admissible replicas: fewest
+    waiting+running requests, then lowest KV pressure, then name (a
+    deterministic tiebreak keeps tests and reruns stable)."""
+    return (stats.queue_depth + stats.active_slots,
+            stats.kv_utilization, stats.name)

@@ -62,9 +62,13 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # extend as new host-only subsystems appear. dataset/prefetch.py: the
 # input pipeline's queue/thread machinery is host-only — its sanctioned
 # placement calls (device_put / make_array_from_process_local_data)
-# lazy-import jax inside the functions that issue them
+# lazy-import jax inside the functions that issue them.
+# serving/: the router/pool/prefix-cache plane is host orchestration
+# over the batcher API — device work stays inside the batchers it
+# drives (the ContinuousBatcher class itself is lazy-imported)
 HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
-                      "bigdl_tpu/dataset/prefetch.py")
+                      "bigdl_tpu/dataset/prefetch.py",
+                      "bigdl_tpu/serving/")
 
 # the per-iteration-sync flavor of JX1 only applies to library code:
 # tests and dev tooling are host drivers that sync deliberately

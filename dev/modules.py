@@ -27,6 +27,7 @@ MODULES = {
                "tests/test_serving.py", "tests/test_perf_paths.py"],
     "observability": ["tests/test_observability.py",
                       "tests/test_telemetry.py"],
+    "serving": ["tests/test_serving_router.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],

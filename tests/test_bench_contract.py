@@ -190,6 +190,79 @@ class TestInputPipelineOverlapRow:
         assert "bench_input_pipeline_overlap 0.25" in text
 
 
+class TestServingRows:
+    """ISSUE 6 satellite: serving_ttft (p50/p99) and
+    serving_tokens_per_sec at a fixed SLO through the router, riding
+    the standard row/known/all contract."""
+
+    def test_rows_registered_and_wired(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        ttft = {"metric": "serving_ttft", "value": 0.05,
+                "unit": "seconds", "ttft_p50_s": 0.05,
+                "ttft_p99_s": 0.25, "within_slo": True,
+                "prefix_prefill_skips": 2, "disagg_prefills": 1}
+        tps = {"metric": "serving_tokens_per_sec", "value": 512.0,
+               "unit": "tokens/sec", "within_slo": True}
+        monkeypatch.setattr(bench, "bench_serving_ttft",
+                            lambda **kw: dict(ttft))
+        monkeypatch.setattr(bench, "bench_serving_tokens_per_sec",
+                            lambda **kw: dict(tps))
+        bench.main(["--rows", "serving_ttft,serving_tokens_per_sec"])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "serving_ttft"
+        assert lines[1]["metric"] == "serving_tokens_per_sec"
+        agg = lines[-1]
+        assert [r["metric"] for r in agg["rows"]] == [
+            "serving_ttft", "serving_tokens_per_sec"]
+        # mirrored into the process registry like every other row
+        from bigdl_tpu.observability.registry import default_registry
+        assert default_registry().get(
+            "bench_serving_tokens_per_sec").value() == 512.0
+
+    def test_rows_in_all(self, monkeypatch, capsys):
+        """`--rows all` must include the serving rows (regression gate:
+        a silently dropped row reads as healthy). The probe-failure
+        path emits one structured error row per REQUESTED metric, so it
+        exposes exactly what "all" expands to."""
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        metrics = [r["metric"] for r in agg["rows"]]
+        assert "serving_ttft" in metrics
+        assert "serving_tokens_per_sec" in metrics
+
+    @pytest.fixture
+    def _restore_dtype_policy(self):
+        """The real bench row sets the global bf16 policy (as every
+        bench row does); the suite's later torch-parity/golden tests
+        need it back."""
+        from bigdl_tpu.tensor import get_policy, set_policy
+        old = get_policy()
+        yield
+        set_policy(old)
+
+    @pytest.mark.parametrize("row", ["serving_ttft",
+                                     "serving_tokens_per_sec"])
+    def test_real_row_tiny_geometry(self, row, _restore_dtype_policy):
+        """A REAL 2-replica router run (tiny model) produces a sane
+        row: the shared workload is cached, so the pair costs one
+        run."""
+        fn = getattr(bench, f"bench_{row}")
+        out = fn(n_requests=6, d_model=32, num_layers=2)
+        assert out["metric"] == row
+        assert out["value"] >= 0
+        assert out["replicas"] == 2 and out["n_requests"] == 6
+        assert out["slo"]["long_prefill_tokens"] == 128
+        assert isinstance(out["within_slo"], bool)
+        if row == "serving_ttft":
+            assert out["ttft_p99_s"] >= out["ttft_p50_s"] >= 0
+            assert out["prefix_prefill_skips"] >= 1
+            assert out["disagg_prefills"] >= 1
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:

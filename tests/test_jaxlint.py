@@ -392,6 +392,33 @@ class TestJX5HostOnlyImports:
         found = jaxlint.analyze_file(path, repo)
         assert [f for f in found if f.rule == "JX5"] == [], path
 
+    def test_serving_router_plane_is_host_only(self):
+        """ISSUE 6 satellite pin: the serving router plane
+        (bigdl_tpu/serving/) is host orchestration — a module-level jax
+        import in any of its modules is a JX5 finding (the
+        ContinuousBatcher class is lazy-imported where needed), and the
+        shipped files are clean."""
+        for mod in ("__init__.py", "router.py", "replica_pool.py",
+                    "prefix_cache.py", "slo.py"):
+            rel = f"bigdl_tpu/serving/{mod}"
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, "bigdl_tpu", "serving", mod)
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+        # the sanctioned lazy-import shape stays clean
+        out = lint("""
+            def build(self, model):
+                from bigdl_tpu.models.transformer.serving import (
+                    ContinuousBatcher)
+                return ContinuousBatcher(model, max_batch=1,
+                                         num_pages=8)
+        """, rel="bigdl_tpu/serving/replica_pool.py")
+        assert out == []
+
     def test_telemetry_plane_modules_are_covered(self):
         """Satellite pin: the host-only prefix covers the telemetry
         plane — a module-level jax import in exporter.py /

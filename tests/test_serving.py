@@ -19,6 +19,7 @@ from bigdl_tpu.models import TransformerLM
 from bigdl_tpu.models.transformer.generate import (GenerationConfig,
                                                    generate)
 from bigdl_tpu.models.transformer.serving import (ContinuousBatcher,
+                                                  KVSnapshot,
                                                   PagedKVCache,
                                                   generate_ragged,
                                                   paged_decode,
@@ -316,6 +317,136 @@ def test_speculative_sampling_matches_target_distribution():
 
     tv = 0.5 * np.abs(joint(got) - joint(want)).sum()
     assert tv < 0.12, f"TV distance {tv:.3f} — distributions diverge"
+
+
+def _batcher(model, **kw):
+    from bigdl_tpu.observability.exporter import HealthRegistry
+    from bigdl_tpu.observability.registry import MetricRegistry
+    cfg = dict(max_batch=2, num_pages=32, page_size=4,
+               max_new_tokens=6, max_burst=4)
+    cfg.update(kw)
+    return ContinuousBatcher(model, registry=MetricRegistry(),
+                             health=HealthRegistry(), **cfg)
+
+
+class TestBatcherRouterHooks:
+    """ISSUE 6 satellites: duplicate-id rejection, cancel(), and the
+    KV export/adopt handoff the router builds on."""
+
+    def test_duplicate_request_id_raises(self):
+        cb = _batcher(_lm(seed=6))
+        cb.submit("r", _prompts([3])[0])
+        with pytest.raises(ValueError, match="duplicate"):
+            cb.submit("r", _prompts([4])[0])
+        cb.run_to_completion(burst=4)
+        # a finished id may be reused
+        cb.submit("r", _prompts([3])[0])
+        cb.run_to_completion(burst=4)
+
+    def test_cancel_queued_and_inflight_frees_pages(self):
+        model = _lm(seed=6)
+        cb = _batcher(model, max_batch=1)
+        p1, p2 = _prompts([3, 4], seed=8)
+        cb.submit("a", p1)
+        cb.submit("b", p2)
+        cb.step(burst=2)                 # admits "a", "b" still queued
+        assert cb.cancel("b") is True    # queued: removed
+        assert cb.cancel("a") is True    # in flight: slot + pages freed
+        assert cb.cancel("a") is False   # unknown/done: no-op
+        assert cb.idle
+        assert cb.finished() == []       # nothing reported
+        assert cb.cache.pages_free == 32 - 1
+        assert cb._m_cancel.value() == 2
+
+    def test_export_adopt_resumes_bitwise(self):
+        """Mid-decode handoff: export on one batcher, adopt on another,
+        the continuation is the model's own greedy decode."""
+        model = _lm(seed=6)
+        src, dst = _batcher(model), _batcher(model)
+        p = _prompts([5], seed=9)[0]
+        src.submit("m", p)
+        src.step(burst=2)                # prefill + 2 decode tokens
+        snap = src.export_request("m")
+        assert src.cache.pages_free == 32 - 1
+        assert 1 <= len(snap.emitted) < 6 and snap.n_cached > len(p)
+        dst.submit("m", snapshot=snap)
+        out = dict(dst.run_to_completion(burst=4))
+        want = np.asarray(generate(
+            model, np.asarray([p], np.int32),
+            GenerationConfig(max_new_tokens=6, temperature=0.0)))[0]
+        np.testing.assert_array_equal(out["m"], want)
+        assert dst._m_skips.value() == 1
+        assert dst.cache.pages_free == 32 - 1
+
+    def test_prefill_only_snapshot_adopts_without_prefill(self):
+        model = _lm(seed=6)
+        pre, dec = _batcher(model), _batcher(model)
+        p = _prompts([7], seed=10)[0]
+        snap = pre.prefill_only("x", p)
+        # the prefill side kept nothing
+        assert pre.cache.pages_free == 32 - 1
+        assert snap.n_cached == len(p) and len(snap.emitted) == 1
+        dec.submit("x", snapshot=snap)
+        out = dict(dec.run_to_completion(burst=4))
+        want = np.asarray(generate(
+            model, np.asarray([p], np.int32),
+            GenerationConfig(max_new_tokens=6, temperature=0.0)))[0]
+        np.testing.assert_array_equal(out["x"], want)
+        assert dec._m_skips.value() == 1
+
+    def test_snapshot_geometry_mismatch_rejected(self):
+        model = _lm(seed=6)
+        src = _batcher(model)
+        other = _batcher(model, page_size=8)
+        snap = src.prefill_only("x", _prompts([5])[0])
+        with pytest.raises(ValueError, match="page_size"):
+            other.submit("x", snapshot=snap)
+        with pytest.raises(ValueError, match="prompt OR snapshot"):
+            src.submit("x", [1, 2], snapshot=snap)
+        with pytest.raises(ValueError, match="prompt or a snapshot"):
+            src.submit("x")
+
+    def test_on_complete_hook_fires_per_retirement(self):
+        model = _lm(seed=6)
+        cb = _batcher(model)
+        done = []
+        cb.on_complete = lambda rid, toks: done.append((rid, toks))
+        for i, p in enumerate(_prompts([3, 5], seed=11)):
+            cb.submit(i, p)
+        results = dict(cb.run_to_completion(burst=4))
+        assert dict(done) == results
+
+    def test_on_prefill_hook_snapshot_is_prefix_clean(self):
+        """The hook fires after prefill but BEFORE any decode write, so
+        the captured snapshot replays the prompt exactly."""
+        model = _lm(seed=6)
+        cb = _batcher(model)
+        caught = {}
+        cb.on_prefill = lambda rid, prompt, fn: caught.update(
+            {rid: (prompt, fn())})
+        p = _prompts([6], seed=12)[0]
+        cb.submit("h", p)
+        out = dict(cb.run_to_completion(burst=4))
+        prompt, snap = caught["h"]
+        assert prompt == p
+        assert isinstance(snap, KVSnapshot)
+        assert snap.n_cached == len(p)
+        assert snap.emitted == [out["h"][0]]
+        cb.submit("h2", snapshot=snap)
+        out2 = dict(cb.run_to_completion(burst=4))
+        np.testing.assert_array_equal(out2["h2"], out["h"])
+
+    def test_pop_queued_returns_unadmitted(self):
+        model = _lm(seed=6)
+        cb = _batcher(model, max_batch=1)
+        ps = _prompts([3, 4, 5], seed=13)
+        for i, p in enumerate(ps):
+            cb.submit(i, p)
+        cb.step(burst=2)                 # admits 0; 1 and 2 queued
+        popped = cb.pop_queued()
+        assert [rid for rid, _ in popped] == [1, 2]
+        assert popped[0][1] == ps[1]     # payload is the prompt
+        assert sorted(dict(cb.run_to_completion(burst=4))) == [0]
 
 
 def test_speculative_validates_args():
