@@ -194,6 +194,91 @@ def bench_convnet_synthetic(model_name: str, batch: int = BATCH,
     return out
 
 
+# headline synthetic run shared by the headline and train_mfu rows (the
+# row fns are what tests monkeypatch; this cache is what makes requesting
+# both cost one training run)
+_headline_cache = None
+
+
+def _headline_row() -> dict:
+    global _headline_cache
+    if _headline_cache is None:
+        _headline_cache = bench_convnet_synthetic("inception_v1",
+                                                  headline=True)
+    return dict(_headline_cache)
+
+
+def bench_train_mfu():
+    """Training MFU as a first-class gated metric (ISSUE 7): achieved
+    model FLOP utilization of the headline Inception-v1 synthetic train
+    step against the chip's bf16 peak. Shares the headline row's run."""
+    row = _headline_row()
+    peak = row.get("chip_peak_tflops_bf16")
+    return {
+        "metric": "train_mfu",
+        "value": row.get("mfu", 0.0) if peak else 0.0,
+        "unit": "fraction of bf16 peak",
+        "images_per_sec_per_chip": row.get("value"),
+        "achieved_tflops": row.get("achieved_tflops"),
+        "chip_peak_tflops_bf16": peak,
+        "peak_known": bool(peak),
+    }
+
+
+def _wire_probe_geometry() -> dict:
+    return dict(d_in=256, d_hidden=1024, layers=3, batch=512,
+                bucket_kb=512)
+
+
+def bench_collective_wire_bytes():
+    """Static per-step collective wire accounting for the sharded-update
+    step at fp32 vs bf16 vs int8 wire codecs (ISSUE 7): the compiled
+    HLO's collective payloads under a ring schedule. Runs the lowering
+    in a SUBPROCESS on the 8-virtual-CPU-device mesh — the accounting is
+    static, backend-independent, and must not disturb (or hang on) this
+    process's TPU backend."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+               .strip())
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--wire-probe"],
+        capture_output=True, text=True, timeout=600, env=env)
+    payload = None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            payload = json.loads(line)
+    if payload is None:
+        tail = (out.stderr or "").strip().splitlines()[-2:]
+        raise RuntimeError(
+            f"wire probe subprocess rc={out.returncode}: "
+            + (" | ".join(tail) or "no output"))
+    wb = payload["wire_bytes_per_chip"]
+    red = payload["reduction_vs_fp32"]
+    return {
+        "metric": "collective_wire_bytes_per_step",
+        "value": wb["int8"],
+        "unit": "bytes/chip/step (int8 wire)",
+        "wire_bytes_per_chip_fp32": wb["fp32"],
+        "wire_bytes_per_chip_bf16": wb["bf16"],
+        "wire_bytes_per_chip_int8": wb["int8"],
+        "reduction_bf16_vs_fp32": round(red["bf16"], 3),
+        "reduction_int8_vs_fp32": round(red["int8"], 3),
+        "geometry": payload["geometry"],
+        "n_shards": payload["n_shards"],
+    }
+
+
+def _wire_probe_main():
+    """--wire-probe subprocess entry: lower the explicit sharded step on
+    the virtual CPU mesh at each codec and emit the accounting JSON."""
+    from bigdl_tpu.optim.sharded_update import wire_bytes_probe
+    from bigdl_tpu.parallel import Engine
+    Engine.init()
+    _emit(wire_bytes_probe(**_wire_probe_geometry()))
+
+
 def _ensure_shards() -> str:
     """Synthetic ImageNet-like JPEG shards (photo-statistics content,
     shorter side 256 like the reference's seqfile generator), built once
@@ -882,7 +967,8 @@ def main(argv=None):
                              "real_cached,resnet50,vgg16,transformer,"
                              "decode,decode_ragged,decode_spec,"
                              "input_pipeline,serving_ttft,"
-                             "serving_tokens_per_sec")
+                             "serving_tokens_per_sec,train_mfu,"
+                             "collective_wire_bytes_per_step")
     parser.add_argument("--probe-timeout", type=float,
                         # BENCH_r05: a wedged TPU tunnel hung backend init
                         # for the full 300 s — fail fast instead. The
@@ -905,10 +991,15 @@ def main(argv=None):
                              "/readyz; 0 = ephemeral port)")
     parser.add_argument("--host-probe", type=float, default=None,
                         help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--wire-probe", action="store_true",
+                        help=argparse.SUPPRESS)   # subprocess entry
     args = parser.parse_args(argv)
     if args.host_probe is not None:
         _emit({"host_pipeline_img_per_sec":
                round(host_pipeline_probe(args.host_probe), 1)})
+        return
+    if args.wire_probe:
+        _wire_probe_main()
         return
     global _metrics_server
     if args.serve_metrics is not None:
@@ -929,19 +1020,51 @@ def main(argv=None):
 _metrics_server = None
 
 
+def _dump_bench_postmortem(exc: Exception, *, reason: str) -> str | None:
+    """BENCH_r05: a wedged/dead backend must leave the same black box a
+    crashed training run does — exception.json, registry.json (whatever
+    rows DID land), trace, events — under
+    ``$BIGDL_TPU_POSTMORTEM_DIR``/tmp. Returns the directory."""
+    try:
+        from bigdl_tpu.observability.flight_recorder import FlightRecorder
+        return FlightRecorder().dump_postmortem(exc, reason=reason)
+    except Exception as e:          # the postmortem must never mask the row
+        print(f"bench postmortem failed: {e}", file=sys.stderr)
+        return None
+
+
+# error substrings that mean the jax backend itself is gone — every
+# later row would crash or hang the same way (BENCH_r04: the inception
+# row died in its first eager convert_element_type with this text and
+# took the whole run down as a raw rc=1 traceback)
+_BACKEND_DEATH_MARKERS = ("Unable to initialize backend",
+                          "backend setup/compile error",
+                          "UNAVAILABLE:")
+
+
+def _backend_death(e: BaseException) -> bool:
+    text = f"{e}"
+    return any(m in text for m in _BACKEND_DEATH_MARKERS)
+
+
 def _run(args):
+    global _headline_cache
+    _headline_cache = None      # per-invocation cache (tests re-enter)
     rows = (["headline"] if args.headline_only
             else [r.strip() for r in args.rows.split(",")])
     if args.rows == "all" and not args.headline_only:
-        rows = ["headline", "inception_v2", "real", "real_cached",
-                "resnet50", "vgg16", "transformer", "decode",
-                "decode_ragged", "decode_spec", "input_pipeline",
-                "serving_ttft", "serving_tokens_per_sec"]
+        rows = ["headline", "train_mfu", "inception_v2", "real",
+                "real_cached", "resnet50", "vgg16", "transformer",
+                "decode", "decode_ragged", "decode_spec",
+                "input_pipeline", "serving_ttft",
+                "serving_tokens_per_sec",
+                "collective_wire_bytes_per_step"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
              "decode_ragged", "decode_spec", "input_pipeline",
-             "serving_ttft", "serving_tokens_per_sec"}
+             "serving_ttft", "serving_tokens_per_sec", "train_mfu",
+             "collective_wire_bytes_per_step"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -952,7 +1075,10 @@ def _run(args):
         # fail fast AND structured: one error row per REQUESTED metric,
         # emitted immediately, so the driver sees exactly which rows the
         # wedged backend cost it (BENCH_r05 hung 300 s and reported only
-        # the headline)
+        # the headline) — plus a flight-recorder postmortem so the
+        # failure is debuggable after the fact, not just counted
+        pm = _dump_bench_postmortem(RuntimeError(err),
+                                    reason="bench backend init failure")
         rows_out = []
         for row in rows:
             r = {"metric": ("inception_v1_train_images_per_sec_per_chip"
@@ -962,6 +1088,8 @@ def _run(args):
                  "error": err}
             if row == "headline":
                 r["vs_baseline"] = 0.0
+            if pm:
+                r["postmortem"] = pm
             rows_out.append(r)
             _emit(r)
         _emit_aggregate(rows_out)
@@ -969,8 +1097,9 @@ def _run(args):
     print(f"# backend: {info}", file=sys.stderr)
 
     fns = {
-        "headline": lambda: bench_convnet_synthetic("inception_v1",
-                                                    headline=True),
+        "headline": _headline_row,
+        "train_mfu": bench_train_mfu,
+        "collective_wire_bytes_per_step": bench_collective_wire_bytes,
         "inception_v2": lambda: bench_convnet_synthetic("inception_v2"),
         "real": lambda: bench_real_data(0.0),
         "real_cached": lambda: bench_real_data(2.0),
@@ -986,18 +1115,39 @@ def _run(args):
     }
     rows_out: list[dict] = []
     headline_failed = False
-    for row in rows:
+    backend_died = None
+    for i, row in enumerate(rows):
         try:
             out = fns[row]()
             rows_out.append(out)
             _emit(out)
         except Exception as e:   # a broken row must not lose the others
-            rows_out.append({"metric": row, "error": f"{type(e).__name__}: "
-                                                     f"{e}"})
+            err = f"{type(e).__name__}: {e}"
+            rows_out.append({"metric": row, "error": err})
             print(f"bench row {row} failed: {e}", file=sys.stderr)
             if row == "headline":
                 headline_failed = True
+            if _backend_death(e):
+                # BENCH_r04: the backend died under a row (a probe can
+                # pass and the tunnel still wedge on the next init).
+                # Every remaining row would crash or hang on the same
+                # corpse — report them all as structured errors NOW and
+                # stop touching the device
+                backend_died = err
+                for rest in rows[i + 1:]:
+                    r = {"metric": rest, "value": 0.0, "unit": "",
+                         "error": f"skipped: backend died in row "
+                                  f"{row} ({err})"}
+                    rows_out.append(r)
+                    _emit(r)
+                break
     _emit_aggregate(rows_out)
+    if backend_died is not None:
+        pm = _dump_bench_postmortem(RuntimeError(backend_died),
+                                    reason="bench backend death mid-run")
+        if pm:
+            print(f"# postmortem: {pm}", file=sys.stderr)
+        raise SystemExit(3)
     if args.metrics_out:
         from bigdl_tpu.observability.registry import default_registry
         reg = default_registry()

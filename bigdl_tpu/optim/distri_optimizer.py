@@ -49,11 +49,23 @@ class DistriOptimizer(Optimizer):
 
     def __init__(self, model, dataset, criterion, batch_size=None, *,
                  mesh=None, shard_optim_state: bool = False,
+                 shard_weight_update: bool = False, wire_codec=None,
+                 bucket_mb: float = 4.0,
                  tensor_parallel: bool | str = False,
                  sequence_parallel: bool | str = False, **kw):
         super().__init__(model, dataset, criterion, batch_size, **kw)
         self.mesh = mesh
         self.shard_optim_state = shard_optim_state
+        # fully cross-replica-sharded update (optim/sharded_update.py):
+        # reduce-scatter grads in buckets, 1/N update math + optimizer
+        # state per replica, all-gather params; wire_codec None keeps
+        # the bit-identical implicit construction, "fp32"/"bf16"/"int8"
+        # run explicit (compressed) per-shard collectives
+        if shard_weight_update or wire_codec is not None:
+            self.set_sharded_update(True, wire_codec=wire_codec,
+                                    bucket_mb=bucket_mb)
+        else:
+            self.bucket_mb = float(bucket_mb)
         # True / axis name: store params sharded over the mesh 'model'
         # axis and let XLA's SPMD partitioner split the math
         # (parallel/tensor_parallel.py)
@@ -89,6 +101,52 @@ class DistriOptimizer(Optimizer):
             "collectives per step: %d ops, %.1f MB logical, %.1f MB wire "
             "per chip (ring estimate)", acct["ops"],
             acct["logical_bytes"] / 1e6, acct["wire_bytes_per_chip"] / 1e6)
+
+    def _init_sharded_update(self, mesh, params):
+        """Validate + build the ShardedWeightUpdate mechanics (None when
+        the feature is off). Raises on configurations whose layouts
+        conflict with the flat-bucket construction."""
+        if not (self.shard_weight_update or self.wire_codec is not None):
+            return None
+        if self.tensor_parallel or self.sequence_parallel:
+            raise ValueError(
+                "shard_weight_update shards flat parameter buckets over "
+                "the data axis and requires replicated parameters — it "
+                "does not compose with tensor_parallel/sequence_parallel")
+        if self.shard_optim_state:
+            raise ValueError(
+                "shard_weight_update subsumes shard_optim_state (ZeRO-1): "
+                "optimizer state is already stored 1/N per replica in "
+                "bucket slices — drop shard_optim_state")
+        if "data" not in mesh.axis_names:
+            raise ValueError(
+                f"shard_weight_update needs a 'data' mesh axis, mesh has "
+                f"{mesh.axis_names}")
+        from bigdl_tpu.parameters.compression import get_codec
+        codec = get_codec(self.wire_codec)
+        if codec is not None and self._pad_stage is not None:
+            raise ValueError(
+                "pad_partial_batches does not compose with an explicit "
+                "wire codec: the per-shard loss cannot see the global "
+                "valid-row count — use wire_codec=None (implicit sharded "
+                "update) or disable padding")
+        optim = self.optim_method
+        for what in ("learning_rates", "weight_decays"):
+            spec = getattr(optim, what, None)
+            if spec is not None and jax.tree.structure(spec) != \
+                    jax.tree.structure(0):
+                raise ValueError(
+                    f"shard_weight_update flattens params into wire "
+                    f"buckets, so a params-shaped {what} tree cannot be "
+                    "matched leafwise — use scalar hyperparameters")
+        from bigdl_tpu.optim.sharded_update import ShardedWeightUpdate
+        su = ShardedWeightUpdate(mesh, optim, params, wire_codec=codec,
+                                 bucket_mb=self.bucket_mb)
+        logger.info(
+            "sharded weight update: %d buckets over %d-way data axis, "
+            "wire codec %s", len(su.buckets), su.n,
+            codec.name if codec is not None else "implicit/fp32")
+        return su
 
     def _shard_batch(self, data, labels, sharding,
                      label_sharding=None):
@@ -143,6 +201,15 @@ class DistriOptimizer(Optimizer):
                         "is_epoch_end": False, "loss": float("inf")}
         opt_state, rng, count_this_epoch, batches_to_skip = \
             self._resume(optim, params)
+        su = self._init_sharded_update(mesh, params)
+        if su is None and isinstance(opt_state, dict) \
+                and "ef_residual" in opt_state:
+            # resuming a compressed-collective checkpoint into a run
+            # without error feedback: the residual is meaningless here
+            opt_state = {k: v for k, v in opt_state.items()
+                         if k != "ef_residual"}
+            logger.info("dropping checkpointed error-feedback residual "
+                        "(sharded update with int8 codec not active)")
 
         repl = replicated(mesh)
         batch_shard = data_sharding(mesh)
@@ -188,42 +255,83 @@ class DistriOptimizer(Optimizer):
                 sharding_for_tree_like
             opt_shard = sharding_for_tree_like(opt_state, params,
                                                tp_tree, repl)
-        params = jax.device_put(params, param_shard)
-        mstate = jax.device_put(mstate, repl)
-        opt_state = jax.device_put(opt_state, opt_shard)
+        if su is not None:
+            # sharded update owns both layouts: flat bucket slices for
+            # optimizer state, and (explicit codecs) master slices for
+            # params (optim/sharded_update.py)
+            mstate = jax.device_put(mstate, repl)
+            opt_state = su.import_opt_state(opt_state, params)
+            params = su.import_params(params)
+            param_shard = su.params_sharding()
+            opt_shard = su.opt_state_sharding(opt_state)
+        else:
+            params = jax.device_put(params, param_shard)
+            mstate = jax.device_put(mstate, repl)
+            opt_state = jax.device_put(opt_state, opt_shard)
 
         use_mask = self._pad_stage is not None
         if use_mask:
             from bigdl_tpu.nn.criterion import MaskedCriterion
             masked = MaskedCriterion(criterion)
 
-        def train_step(params, mstate, opt_state, rng, data, labels, epoch,
-                       n_valid=None):
-            if self.input_transform is not None:
-                data = self.input_transform(data)
+        if su is not None and su.codec is not None:
+            # explicit construction: the whole step runs per-shard under
+            # shard_map — local forward/backward, bucketed compressed
+            # reduce-scatter (+ error feedback), sharded update on f32
+            # masters, compressed param all-gather
+            def local_vag(p, mstate_in, data, labels, key):
+                if self.input_transform is not None:
+                    data = self.input_transform(data)
 
-            def loss_fn(p):
-                y, new_mstate = model.apply(p, mstate, data, training=True,
-                                            rng=rng)
-                if use_mask:
-                    # validity mask from the real row count: padded rows
-                    # contribute exactly zero to loss and the gradient
-                    # allreduce (nn.MaskedCriterion); XLA shards the
-                    # iota like the batch
-                    mask = jnp.arange(data.shape[0]) < n_valid
-                    return masked.apply(y, labels, mask), new_mstate
-                # mean over the GLOBAL batch — the gradient allreduce this
-                # induces in backward IS the reference's whole
-                # parameters/AllReduceParameter machinery
-                return criterion.apply(y, labels), new_mstate
+                def loss_fn(pp):
+                    y, new_mstate = model.apply(pp, mstate_in, data,
+                                                training=True, rng=key)
+                    return criterion.apply(y, labels), new_mstate
 
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            grads = _clip_gradients(grads, self.grad_clip)
-            opt_state = dict(opt_state, epoch=epoch)
-            new_params, new_opt_state = optim.update(grads, params,
-                                                     opt_state)
-            return new_params, new_mstate, new_opt_state, loss
+                return jax.value_and_grad(loss_fn, has_aux=True)(p)
+
+            explicit_step = su.make_explicit_step(
+                local_vag, grad_clip=self.grad_clip)
+
+            def train_step(params, mstate, opt_state, rng, data, labels,
+                           epoch, n_valid=None):
+                return explicit_step(params, mstate, opt_state, rng,
+                                     data, labels, epoch)
+        else:
+            def train_step(params, mstate, opt_state, rng, data, labels,
+                           epoch, n_valid=None):
+                if self.input_transform is not None:
+                    data = self.input_transform(data)
+
+                def loss_fn(p):
+                    y, new_mstate = model.apply(p, mstate, data,
+                                                training=True, rng=rng)
+                    if use_mask:
+                        # validity mask from the real row count: padded
+                        # rows contribute exactly zero to loss and the
+                        # gradient allreduce (nn.MaskedCriterion); XLA
+                        # shards the iota like the batch
+                        mask = jnp.arange(data.shape[0]) < n_valid
+                        return masked.apply(y, labels, mask), new_mstate
+                    # mean over the GLOBAL batch — the gradient allreduce
+                    # this induces in backward IS the reference's whole
+                    # parameters/AllReduceParameter machinery
+                    return criterion.apply(y, labels), new_mstate
+
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                grads = _clip_gradients(grads, self.grad_clip)
+                opt_state = dict(opt_state, epoch=epoch)
+                if su is not None:
+                    # implicit construction: same loss/grads as the
+                    # replicated path (bit-identical), update math and
+                    # optimizer state sharded 1/N under shard_map
+                    new_params, new_opt_state = su.apply_update(
+                        grads, params, opt_state)
+                else:
+                    new_params, new_opt_state = optim.update(
+                        grads, params, opt_state)
+                return new_params, new_mstate, new_opt_state, loss
 
         # label_shard is None under sequence_parallel (rank-derived at
         # placement, _shard_batch); jit then inherits the arg sharding
@@ -246,6 +354,9 @@ class DistriOptimizer(Optimizer):
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 
+        # sharded update: evaluation/checkpoint see the gathered f32
+        # params tree (masters), so eval shardings are replicated
+        eval_param_shard = repl if su is not None else param_shard
         if jax.process_count() > 1:
             # multi-host in-training validation: per-process shards can't
             # be device_put onto the global mesh (round-5 review finding:
@@ -258,7 +369,7 @@ class DistriOptimizer(Optimizer):
         else:
             from bigdl_tpu.optim.validator import _padded_eval
             jit_eval = jax.jit(eval_apply,
-                               in_shardings=(param_shard, repl,
+                               in_shardings=(eval_param_shard, repl,
                                              batch_shard),
                                out_shardings=batch_shard)
             # params stay in their training placement (param_shard may be
@@ -396,16 +507,24 @@ class DistriOptimizer(Optimizer):
                     pipeline = self._open_train_pipeline(
                         place, records_scale=jax.process_count())
                 fire_val, fire_ckpt = self._fires(driver_state)
+                ptree, opt_export = params, opt_state
                 if fire_val or fire_ckpt:
                     # validation/checkpoint read host-visible state: flush
                     # the window first, then publish params (host-side
-                    # tree walk is overhead on deep models)
+                    # tree walk is overhead on deep models). Sharded
+                    # update: gather the f32 masters and re-shape the
+                    # bucketed optimizer state back to the params-shaped
+                    # (ZeRO-1-compatible) checkpoint layout
                     self._drain_pending(pending, driver_state,
                                         "validation/checkpoint trigger")
-                    model.sync(params, mstate)
-                self._validate(eval_fn, params, mstate, driver_state,
+                    if su is not None:
+                        ptree = su.gather_params(params)
+                        if fire_ckpt:
+                            opt_export = su.export_opt_state(opt_state)
+                    model.sync(ptree, mstate)
+                self._validate(eval_fn, ptree, mstate, driver_state,
                                fire=fire_val)
-                self._checkpoint(driver_state, opt_state, rng,
+                self._checkpoint(driver_state, opt_export, rng,
                                  count_this_epoch, batches_this_epoch,
                                  epoch_start_host_rng, fire=fire_ckpt)
         finally:
@@ -413,6 +532,7 @@ class DistriOptimizer(Optimizer):
 
         self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()
-        model.sync(params, mstate)
+        model.sync(su.gather_params(params) if su is not None else params,
+                   mstate)
         model.evaluate()
         return model

@@ -133,6 +133,13 @@ class Optimizer:
         # async dispatch: how many steps may be in flight before the loop
         # drains their losses with one packed readback (docs/PERFORMANCE.md)
         self.max_in_flight = 2
+        # fully sharded weight update + wire-compressed collectives
+        # (optim/sharded_update.py, docs/PERFORMANCE.md): active on the
+        # distributed path; the local single-program path has no
+        # collectives, so the setting is accepted and inert there
+        self.shard_weight_update = False
+        self.wire_codec = None
+        self.bucket_mb = 4.0
         # overlapped input pipeline (dataset/prefetch.py): batches are
         # assembled + device-placed on a worker thread, `depth` ahead of
         # the loop; 0 = the synchronous path (docs/PERFORMANCE.md)
@@ -266,6 +273,33 @@ class Optimizer:
 
     def set_end_when(self, end_when: Trigger):
         self.end_when = end_when
+        return self
+
+    def set_sharded_update(self, enabled: bool = True, *,
+                          wire_codec=None, bucket_mb: float | None = None):
+        """Configure the fully cross-replica-sharded weight update
+        (optim/sharded_update.py, docs/PERFORMANCE.md): reduce-scatter
+        gradients in size-targeted buckets, update parameters +
+        optimizer state 1/N per replica, all-gather updated parameters.
+
+        ``wire_codec``: ``None`` keeps implicit full-width collectives
+        (trajectories bit-identical to the replicated update);
+        ``"fp32"``/``"bf16"``/``"int8"`` switch to explicit per-shard
+        collectives at that wire width — ``"bf16"`` is the reference's
+        FP16 wire, ``"int8"`` adds stochastic rounding + error feedback
+        (the residual rides the optimizer state and checkpoints).
+        ``bucket_mb`` targets the per-bucket payload the backward
+        overlaps against. Only the distributed optimizer has
+        collectives; on the local path this is accepted and inert.
+        Returns self."""
+        from bigdl_tpu.parameters.compression import get_codec
+        get_codec(wire_codec)          # validate the name eagerly
+        self.shard_weight_update = bool(enabled) or wire_codec is not None
+        self.wire_codec = wire_codec
+        if bucket_mb is not None:
+            if bucket_mb <= 0:
+                raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+            self.bucket_mb = float(bucket_mb)
         return self
 
     def set_metrics_server(self, port: int = 0, host: str = "127.0.0.1",
@@ -729,6 +763,11 @@ class LocalOptimizer(Optimizer):
     def _optimize_impl(self):
         model, criterion, optim = self.model, self.criterion, \
             self.optim_method
+        if self.shard_weight_update or self.wire_codec is not None:
+            logger.info(
+                "sharded update / wire codec configured, but the local "
+                "optimizer is one program with no collectives — inert "
+                "(DistriOptimizer runs the sharded path)")
         model.materialize()
         model.training()
         params, mstate = model.params, model.state

@@ -85,6 +85,27 @@ def _wire(x, wire_dtype):
     return x.astype(wire_dtype) if wire_dtype is not None else x
 
 
+def _resolve_codec(codec):
+    from bigdl_tpu.parameters.compression import get_codec
+    return get_codec(codec)
+
+
+def _compressed_scatter_body(v, axis, n, codec, key, mean):
+    """Per-shard body of a wire-compressed reduce-scatter: quantize my
+    full contribution per destination slice, exchange int8/uint16
+    payloads with ``all_to_all`` (the wire stays at codec width — a
+    psum would have to upcast to accumulate), decode the N received
+    contributions and sum locally. Returns my f32 slice."""
+    rows = v.reshape(n, -1)                  # row j = my payload for shard j
+    enc = codec.encode(rows, key)
+    got = {k: jax.lax.all_to_all(p if p.ndim > 1 else p[:, None], axis,
+                                 split_axis=0, concat_axis=0, tiled=False)
+           for k, p in enc.items()}
+    got = {k: (p if enc[k].ndim > 1 else p[..., 0]) for k, p in got.items()}
+    out = jnp.sum(codec.decode(got), axis=0)
+    return out / n if mean else out
+
+
 def all_reduce(x, axis: str = "data", mesh: Mesh | None = None, *,
                mean: bool = False, wire_dtype=None):
     """Reduce N per-shard contributions across ``axis``.
@@ -133,13 +154,25 @@ def pmean_tree(tree, axis: str = "data", mesh: Mesh | None = None, *,
 
 
 def all_gather(x, axis: str = "data", mesh: Mesh | None = None,
-               concat_axis: int = 0):
+               concat_axis: int = 0, *, codec=None):
     """Each shard contributes its block; all get the concatenation
-    (reference AllReduceParameter.getWeights, :134-159)."""
+    (reference AllReduceParameter.getWeights, :134-159).
+
+    ``codec`` (a name from ``parameters.compression.KNOWN_CODECS`` or a
+    ``WireCodec``) compresses the payload on the wire — the reference's
+    FP16 ``getWeights`` is ``codec="bf16"``. Each shard's whole block is
+    one codec row (one scale for int8). Requires f32 input."""
     mesh = mesh or get_mesh()
+    codec = _resolve_codec(codec)
 
     def body(v):
-        out = jax.lax.all_gather(v, axis, tiled=True)
+        if codec is not None and codec.name != "fp32":
+            enc = codec.encode(v.reshape(1, -1))
+            got = {k: jax.lax.all_gather(p, axis, tiled=True)
+                   for k, p in enc.items()}
+            out = codec.decode(got).reshape((-1,) + tuple(v.shape[1:]))
+        else:
+            out = jax.lax.all_gather(v, axis, tiled=True)
         if concat_axis != 0:
             out = jnp.moveaxis(out, 0, concat_axis)
         return out
@@ -149,7 +182,8 @@ def all_gather(x, axis: str = "data", mesh: Mesh | None = None,
 
 
 def reduce_scatter(x, axis: str = "data", mesh: Mesh | None = None, *,
-                   mean: bool = False, wire_dtype=None):
+                   mean: bool = False, wire_dtype=None, codec=None,
+                   key=None):
     """Sum N per-shard contributions; each shard keeps its slice (reference
     putGradients + aggregrateGradientPartition, :161-215).
 
@@ -157,7 +191,14 @@ def reduce_scatter(x, axis: str = "data", mesh: Mesh | None = None, *,
     ``N == mesh.shape[axis]`` — shard ``i`` contributes ``x[i]``. Returns
     the elementwise sum (or mean), shape ``(S, ...)``, sharded over dim 0
     along ``axis`` (each shard owns ``S/N`` rows).
-    """
+
+    ``codec`` compresses the WIRE: each shard quantizes its contribution
+    per destination slice, slices ride an ``all_to_all`` at codec width,
+    and the owner decodes + sums in f32 (a ``psum_scatter`` would have to
+    upcast to accumulate — this construction keeps the payload at wire
+    width end to end). ``key`` enables stochastic rounding for codecs
+    that support it; requires ``S`` divisible by the axis size and rank-1
+    slices."""
     mesh = mesh or get_mesh()
     n = mesh.shape[axis]
     if x.ndim == 0 or x.shape[0] != n:
@@ -166,6 +207,31 @@ def reduce_scatter(x, axis: str = "data", mesh: Mesh | None = None, *,
             f"dim {x.shape[0] if x.ndim else '<scalar>'} != mesh axis "
             f"'{axis}' size {n}")
     orig_dtype = x.dtype
+    codec = _resolve_codec(codec)
+    if codec is not None and codec.name != "fp32":
+        if x.ndim != 2:
+            raise ValueError(
+                "compressed reduce_scatter wants (N, S) stacked flat "
+                f"contributions, got rank {x.ndim}")
+        if x.shape[1] % n != 0:
+            raise ValueError(
+                f"compressed reduce_scatter needs S divisible by the "
+                f"axis size: {x.shape[1]} % {n} != 0 (pad first — "
+                "AllReduceParameter.put_gradients does)")
+
+        def cbody(v, k):
+            return _compressed_scatter_body(v[0], axis, n, codec,
+                                            k, mean).astype(orig_dtype)
+
+        if key is None:
+            body = lambda v: cbody(v, None)
+            return shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis), check_rep=False)(x)
+        # distinct stochastic-rounding stream per shard
+        body = lambda v, k: cbody(
+            v, jax.random.fold_in(k, jax.lax.axis_index(axis)))
+        return shard_map(body, mesh=mesh, in_specs=(P(axis), P()),
+                         out_specs=P(axis), check_rep=False)(x, key)
 
     def body(v):
         v = _wire(v[0], wire_dtype)

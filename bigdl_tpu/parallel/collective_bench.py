@@ -34,7 +34,8 @@ import numpy as np
 __all__ = ["collective_bytes", "allreduce_bench"]
 
 _DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
-             "s64": 8, "u64": 8, "s8": 1, "u8": 1, "pred": 1}
+             "s64": 8, "u64": 8, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+             "pred": 1}
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                 "collective-permute", "all-to-all")

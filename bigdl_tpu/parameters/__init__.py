@@ -1,6 +1,8 @@
 """Distributed parameter aggregation + wire codecs (reference
 dl/.../bigdl/parameters/, SURVEY §2.6)."""
 
-from bigdl_tpu.parameters.all_reduce import AllReduceParameter, slice_bounds
+from bigdl_tpu.parameters.all_reduce import (AllReduceParameter,
+                                             GradientBuckets, slice_bounds)
 from bigdl_tpu.parameters.compression import (FP16CompressedTensor, compress,
-                                              decompress, compressed_add)
+                                              decompress, compressed_add,
+                                              get_codec, KNOWN_CODECS)

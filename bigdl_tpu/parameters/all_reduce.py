@@ -21,12 +21,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.parallel.engine import get_mesh
 from bigdl_tpu.parallel import collective as C
 from bigdl_tpu.tensor import flatten_params
 
-__all__ = ["AllReduceParameter", "slice_bounds"]
+__all__ = ["AllReduceParameter", "slice_bounds", "GradientBuckets"]
 
 
 def slice_bounds(size: int, partition_num: int, pid: int) -> tuple[int, int]:
@@ -45,12 +46,19 @@ class AllReduceParameter:
     def __init__(self, partition_num: int | None = None,
                  size: int | None = None,
                  *, axis: str = "data", mesh=None,
-                 wire_dtype=jnp.bfloat16):
+                 wire_dtype=jnp.bfloat16, wire_codec=None):
         self.mesh = mesh or get_mesh()
         self.axis = axis
         self.partition_num = partition_num or int(self.mesh.shape[axis])
         self.size = size
         self.wire_dtype = wire_dtype
+        # a parameters.compression codec name ("bf16"/"int8") or WireCodec:
+        # routes the gradient reduce-scatter through the wire-compressed
+        # all_to_all construction and the weight all-gather through the
+        # compressed payload path (the reference's FP16 wire, or int8).
+        # None keeps the legacy wire_dtype cast semantics.
+        from bigdl_tpu.parameters.compression import get_codec
+        self.wire_codec = get_codec(wire_codec)
         self._unravel = None
 
     # -- canonical fused path (what DistriOptimizer compiles) --
@@ -80,7 +88,8 @@ class AllReduceParameter:
         self._unravel = unravel
         return flat
 
-    def put_gradients(self, per_shard_grads, *, mean: bool = False):
+    def put_gradients(self, per_shard_grads, *, mean: bool = False,
+                      key=None):
         """reduce-scatter per-shard gradients: each mesh shard ends up
         owning the SUM (or mean) of its slice of the N distinct
         contributions (reference putGradients +
@@ -110,17 +119,125 @@ class AllReduceParameter:
             stacked = jnp.concatenate(
                 [stacked, jnp.zeros((stacked.shape[0], pad), stacked.dtype)],
                 axis=1)
+        if self.wire_codec is not None:
+            return C.reduce_scatter(stacked, self.axis, self.mesh,
+                                    mean=mean, codec=self.wire_codec,
+                                    key=key)
         return C.reduce_scatter(stacked, self.axis, self.mesh, mean=mean,
                                 wire_dtype=self.wire_dtype)
 
     def get_weights(self, sharded_flat):
         """all-gather the updated slices back into the full flat weight
-        (reference sendWeightPartition + getWeights, :134-159,217-228)."""
-        full = C.all_gather(sharded_flat, self.axis, self.mesh)
+        (reference sendWeightPartition + getWeights, :134-159,217-228).
+        With a wire codec set the slices ride compressed, the
+        reference's FP16 getWeights semantics."""
+        full = C.all_gather(sharded_flat, self.axis, self.mesh,
+                            codec=self.wire_codec)
         if self.size is not None:
             full = full[:self.size]
         return self._unravel(full) if self._unravel is not None else full
 
-    def aggregrate_gradient_partition(self, grads):
-        """Reference-named alias (sic) for the reduce-scatter phase."""
+    def aggregate_gradient_partition(self, grads):
+        """The reduce-scatter phase under its correctly spelled name
+        (the reference method is misspelled
+        ``aggregrateGradientPartition``, AllReduceParameter.scala:161)."""
         return self.put_gradients(grads)
+
+    # reference-named alias (sic), kept for drop-in parity with scripts
+    # written against the reference API
+    aggregrate_gradient_partition = aggregate_gradient_partition
+
+
+class GradientBuckets:
+    """Size-targeted flat wire buckets over a params pytree.
+
+    The bucketing layout behind the fully sharded weight update
+    (optim/sharded_update.py): leaves are grouped — in REVERSE tree
+    order, since backward produces the output-side layers' gradients
+    first, so earlier buckets' collectives can overlap the rest of the
+    backward — into dtype-homogeneous flat buckets of roughly
+    ``bucket_bytes`` each, padded to a multiple of ``n_shards`` so every
+    bucket splits into equal :func:`slice_bounds` slices (the
+    AllReduceParameter layout, which keeps ZeRO-1 checkpoints
+    compatible: state exported through :meth:`unflatten` is
+    params-shaped regardless of bucket geometry)."""
+
+    def __init__(self, tree, *, bucket_bytes: int = 4 << 20,
+                 n_shards: int = 1):
+        leaves, self._treedef = jax.tree.flatten(tree)
+        if not leaves:
+            raise ValueError("GradientBuckets needs a non-empty tree")
+        self._shapes = [tuple(l.shape) for l in leaves]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        self._dtypes = [jnp.dtype(l.dtype) for l in leaves]
+        order = list(range(len(leaves)))[::-1]
+        self._buckets: list[dict] = []
+        cur, cur_bytes, cur_dtype = [], 0, None
+        for i in order:
+            nbytes = self._sizes[i] * self._dtypes[i].itemsize
+            if cur and (cur_dtype != self._dtypes[i]
+                        or cur_bytes >= int(bucket_bytes)):
+                self._close(cur, cur_dtype, n_shards)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+            cur_dtype = self._dtypes[i]
+        if cur:
+            self._close(cur, cur_dtype, n_shards)
+        self.n_shards = int(n_shards)
+
+    def _close(self, idxs, dtype, n_shards):
+        size = sum(self._sizes[i] for i in idxs)
+        self._buckets.append({
+            "key": f"b{len(self._buckets):03d}",
+            "idxs": list(idxs),
+            "size": size,
+            "padded": size + ((-size) % int(n_shards)),
+            "dtype": dtype,
+        })
+
+    @property
+    def keys(self) -> list[str]:
+        return [b["key"] for b in self._buckets]
+
+    @property
+    def padded_sizes(self) -> dict:
+        return {b["key"]: b["padded"] for b in self._buckets}
+
+    def __len__(self) -> int:
+        return len(self._buckets)
+
+    def spec(self, leaf_spec) -> dict:
+        """A {bucket key: leaf_spec} dict (shard_map spec helper)."""
+        return {b["key"]: leaf_spec for b in self._buckets}
+
+    def flatten(self, tree) -> dict:
+        """Params-shaped tree -> {bucket key: padded flat vector}."""
+        leaves = jax.tree.leaves(tree)
+        if len(leaves) != len(self._sizes):
+            raise ValueError(
+                f"tree has {len(leaves)} leaves, bucket layout expects "
+                f"{len(self._sizes)}")
+        out = {}
+        for b in self._buckets:
+            parts = [jnp.ravel(leaves[i]) for i in b["idxs"]]
+            pad = b["padded"] - b["size"]
+            if pad:
+                parts.append(jnp.zeros((pad,), b["dtype"]))
+            out[b["key"]] = jnp.concatenate(parts) if len(parts) > 1 \
+                else parts[0]
+        return out
+
+    def unflatten(self, bucket_dict) -> "object":
+        """{bucket key: flat vector} -> params-shaped tree (padding
+        dropped)."""
+        leaves = [None] * len(self._sizes)
+        for b in self._buckets:
+            vec = bucket_dict[b["key"]]
+            off = 0
+            for i in b["idxs"]:
+                n = self._sizes[i]
+                leaves[i] = jnp.reshape(vec[off:off + n],
+                                        self._shapes[i])
+                off += n
+        return jax.tree.unflatten(self._treedef, leaves)

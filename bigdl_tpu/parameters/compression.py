@@ -11,13 +11,25 @@ shipping bf16 on the wire in 2016. On TPU this codec is therefore native:
 Host-side (numpy) and device-side (jnp) variants are provided; the host path
 is used for checkpoint shrinking and tests, the device path rides inside
 jitted steps as ``wire_dtype=jnp.bfloat16``.
+
+Device-side wire codecs (ISSUE 7): jit-composable row-wise codecs used by
+the sharded-update collectives (optim/sharded_update.py,
+parallel/collective.py). ``bf16`` ships the reference's exact uint16
+high-bits wire format (bitcast, so no backend can silently promote the
+payload back to f32); ``int8`` adds symmetric per-row quantization with
+optional stochastic rounding — the unbiased form the error-feedback
+gradient path uses (docs/PERFORMANCE.md).
 """
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["FP16CompressedTensor", "compress", "decompress",
-           "compressed_add"]
+           "compressed_add",
+           "bf16_compress_device", "bf16_decompress_device",
+           "int8_quantize", "int8_dequantize",
+           "WireCodec", "FP32Codec", "BF16Codec", "Int8Codec",
+           "get_codec", "KNOWN_CODECS"]
 
 
 def compress(arr: np.ndarray) -> np.ndarray:
@@ -81,3 +93,135 @@ class FP16CompressedTensor:
 
     par_add = add  # the reference's multi-threaded variant — XLA/NumPy
     # vectorize it; kept as an alias for API parity
+
+
+# ---------------------------------------------------------------------------
+# Device-side codecs (jit-composable). jnp imports stay inside the
+# functions so the host-side checkpoint/test path above never touches a
+# backend.
+# ---------------------------------------------------------------------------
+
+# keeps an all-zero row's scale finite: q = 0, dequant = 0, exact
+_SCALE_FLOOR = 1e-30
+
+
+def bf16_compress_device(x):
+    """f32 -> uint16 high bits on DEVICE — BIT-EXACT host ``compress``
+    parity: the reference truncates (keeps the high 16 bits,
+    FP16CompressedTensor.scala:267-275), so this shifts bits rather than
+    casting to bf16, which would round to nearest. Shipping the uint16
+    bit pattern also pins the wire width: backends that promote bf16
+    compute to f32 (XLA:CPU) cannot widen an integer payload."""
+    import jax
+    import jax.numpy as jnp
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return (bits >> 16).astype(jnp.uint16)
+
+
+def bf16_decompress_device(comp):
+    """uint16 high bits -> f32 on device (host ``decompress`` parity)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(
+        comp.astype(jnp.uint32) << 16, jnp.float32)
+
+
+def int8_quantize(x, key=None):
+    """Symmetric int8 quantization over the LAST axis: ``x`` ``(..., k)``
+    -> ``(q int8 (..., k), scale (...,))`` with ``scale = amax/127``.
+
+    ``key`` enables stochastic rounding — ``floor(y + u)``, ``u ~ U[0,1)``
+    — which is unbiased (``E[q] = y``); the property the error-feedback
+    gradient path relies on. ``key=None`` rounds to nearest
+    (deterministic; used for the weight all-gather wire)."""
+    import jax
+    import jax.numpy as jnp
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0 + _SCALE_FLOOR
+    y = x / scale[..., None]
+    if key is not None:
+        q = jnp.floor(y + jax.random.uniform(key, x.shape))
+    else:
+        q = jnp.round(y)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale.astype(
+        jnp.float32)
+
+
+def int8_dequantize(q, scale):
+    import jax.numpy as jnp
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+class WireCodec:
+    """Row-wise wire codec protocol for the sharded-update collectives.
+
+    ``encode(x, key=None)`` maps a float32 ``(..., k)`` array to a dict of
+    wire arrays (what actually rides the collective); ``decode(enc)``
+    inverts it to f32. ``error_feedback`` marks lossy codecs whose
+    gradient path carries a residual (optim/sharded_update.py);
+    ``wire_bytes_per_element`` is the payload width the bench accounting
+    expects on the wire."""
+
+    name = "fp32"
+    error_feedback = False
+    stochastic = False
+    wire_bytes_per_element = 4.0
+
+    def encode(self, x, key=None):
+        return {"q": x}
+
+    def decode(self, enc):
+        return enc["q"]
+
+
+class FP32Codec(WireCodec):
+    """Identity codec — explicit collectives at full width."""
+
+
+class BF16Codec(WireCodec):
+    """The reference's FP16CompressedTensor wire (uint16 high bits)."""
+
+    name = "bf16"
+    wire_bytes_per_element = 2.0
+
+    def encode(self, x, key=None):
+        return {"q": bf16_compress_device(x)}
+
+    def decode(self, enc):
+        return bf16_decompress_device(enc["q"])
+
+
+class Int8Codec(WireCodec):
+    """Symmetric per-row int8 + f32 scale; stochastic rounding when a
+    key is supplied, error-feedback residual on the gradient path."""
+
+    name = "int8"
+    error_feedback = True
+    stochastic = True
+    wire_bytes_per_element = 1.0
+
+    def encode(self, x, key=None):
+        q, scale = int8_quantize(x, key)
+        return {"q": q, "scale": scale}
+
+    def decode(self, enc):
+        return int8_dequantize(enc["q"], enc["scale"])
+
+
+KNOWN_CODECS = ("fp32", "bf16", "int8")
+_CODECS = {"fp32": FP32Codec, "bf16": BF16Codec, "int8": Int8Codec}
+
+
+def get_codec(name) -> "WireCodec | None":
+    """Resolve a wire-codec name (or pass through a WireCodec / None).
+
+    ``None`` means "no explicit codec": callers treat it as
+    uncompressed implicit collectives (the bit-identical sharded-update
+    path), distinct from ``"fp32"`` which forces the explicit
+    full-width wire."""
+    if name is None or isinstance(name, WireCodec):
+        return name
+    try:
+        return _CODECS[str(name)]()
+    except KeyError:
+        raise ValueError(
+            f"unknown wire codec {name!r} (known: {KNOWN_CODECS})") from None

@@ -16,6 +16,8 @@ MODULES = {
                 "tests/test_native_loader.py", "tests/test_prefetch.py"],
     "optim": ["tests/test_optim.py", "tests/test_checkpoint.py",
               "tests/test_predictor.py", "tests/test_async_dispatch.py"],
+    "parameters": ["tests/test_compression.py",
+                   "tests/test_sharded_update.py"],
     "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",
                  "tests/test_tensor_parallel.py",
                  "tests/test_pipeline_parallel.py",

@@ -263,6 +263,183 @@ class TestServingRows:
             assert out["disagg_prefills"] >= 1
 
 
+class TestTrainMfuRow:
+    """ISSUE 7 satellite: train_mfu rides the headline synthetic run
+    (one training run serves both rows) and reports fraction-of-peak."""
+
+    def test_row_shares_headline_run(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        calls = []
+
+        def fake(name, headline=False):
+            calls.append(name)
+            return {"metric": "inception_v1_train_images_per_sec_per_chip",
+                    "value": 5000.0, "unit": "images/sec/chip",
+                    "vs_baseline": 33.3, "achieved_tflops": 63.4,
+                    "mfu": 0.23, "chip_peak_tflops_bf16": 275.0}
+        monkeypatch.setattr(bench, "bench_convnet_synthetic", fake)
+        bench.main(["--rows", "headline,train_mfu"])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert calls == ["inception_v1"]      # ONE run for both rows
+        assert lines[0]["value"] == 5000.0
+        assert lines[1]["metric"] == "train_mfu"
+        assert lines[1]["value"] == 0.23
+        assert lines[1]["unit"] == "fraction of bf16 peak"
+        assert lines[1]["images_per_sec_per_chip"] == 5000.0
+        agg = lines[-1]
+        assert [r["metric"] for r in agg["rows"]] == [
+            "inception_v1_train_images_per_sec_per_chip", "train_mfu"]
+
+    def test_unknown_peak_reports_zero(self, monkeypatch):
+        monkeypatch.setattr(
+            bench, "bench_convnet_synthetic",
+            lambda name, headline=False: {
+                "metric": "inception_v1_train_images_per_sec_per_chip",
+                "value": 100.0, "unit": "images/sec/chip",
+                "achieved_tflops": 1.0})
+        bench._headline_cache = None
+        row = bench.bench_train_mfu()
+        assert row["value"] == 0.0 and row["peak_known"] is False
+
+
+class TestCollectiveWireBytesRow:
+    """ISSUE 7: static wire accounting for the sharded-update step at
+    fp32 vs bf16 vs int8 — and the acceptance ratio (int8 >= 3x)."""
+
+    def test_real_subprocess_probe(self):
+        row = bench.bench_collective_wire_bytes()
+        assert row["metric"] == "collective_wire_bytes_per_step"
+        assert row["value"] == row["wire_bytes_per_chip_int8"] > 0
+        assert row["wire_bytes_per_chip_fp32"] > \
+            row["wire_bytes_per_chip_bf16"] > \
+            row["wire_bytes_per_chip_int8"]
+        assert row["reduction_int8_vs_fp32"] >= 3.0
+        assert row["reduction_bf16_vs_fp32"] >= 1.9
+        assert row["n_shards"] == 8
+
+    def test_rows_in_all(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        metrics = [r["metric"] for r in agg["rows"]]
+        assert "train_mfu" in metrics
+        assert "collective_wire_bytes_per_step" in metrics
+
+
+class TestBenchRecovery:
+    """ISSUE 7 satellites: round-4 (backend death mid-run must yield
+    structured rows + postmortem, not a raw rc=1 traceback) and round-5
+    (probe failure dumps a flight-recorder postmortem)."""
+
+    def test_inception_step_traces_on_cpu(self):
+        """Regression for the BENCH_r04 crash signature: the inception
+        row's train step TRACES cleanly on CPU — the
+        convert_element_type failure was the dead backend surfacing
+        through the row's first eager op, not a dtype bug in the step.
+        This pins the step itself stays traceable (bf16 policy, int64
+        labels and all) so any future r04-style crash is environmental
+        by elimination."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.tensor import get_policy, set_policy
+        old = get_policy()
+        try:
+            bench._set_bf16_policy()
+            pieces = bench._convnet_pieces("inception_v1")
+            model, params, mstate, opt_state, train_step = pieces
+            host = np.random.default_rng(0)
+            data = jnp.asarray(host.standard_normal((4, 3, 224, 224),
+                                                    np.float32))
+            labels = jnp.asarray(host.integers(1, 1001, size=(4,)))
+            jax.jit(train_step, donate_argnums=(0, 1, 2)).lower(
+                params, mstate, opt_state, jax.random.PRNGKey(0),
+                data, labels)      # raises on any trace-time dtype bug
+        finally:
+            set_policy(old)
+
+    def test_backend_death_mid_run_structured(self, monkeypatch, capsys,
+                                              tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setenv("BIGDL_TPU_POSTMORTEM_DIR", str(tmp_path))
+
+        def dead(name, headline=False):
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+                "backend setup/compile error (Unavailable).")
+        monkeypatch.setattr(bench, "bench_convnet_synthetic", dead)
+        monkeypatch.setattr(bench, "bench_transformer_lm",
+                            lambda: pytest.fail(
+                                "must not touch the dead backend"))
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "headline,transformer,decode"])
+        assert ei.value.code == 3
+        lines = _parse_lines(capsys.readouterr().out)
+        agg = lines[-1]
+        assert agg["metric"] == "aggregate"     # aggregate still emitted
+        assert len(agg["rows"]) == 3
+        assert "Unable to initialize backend" in agg["rows"][0]["error"]
+        for r in agg["rows"][1:]:
+            assert r["error"].startswith("skipped: backend died")
+        # the skipped rows were emitted immediately as structured lines
+        assert any(line.get("metric") == "decode" for line in lines[:-1])
+        # flight-recorder postmortem (exception.json + registry.json)
+        import json as _json
+        with open(tmp_path / "exception.json") as f:
+            exc = _json.load(f)
+        assert "Unable to initialize backend" in \
+            exc["exception"]["message"]
+        assert (tmp_path / "registry.json").exists()
+
+    def test_ordinary_row_failure_does_not_trip_death_path(
+            self, monkeypatch, capsys):
+        """A plain row exception must keep the old contract: later rows
+        still run, exit code stays row-level."""
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+
+        def boom():
+            raise RuntimeError("no tokens today")
+        ran = []
+        monkeypatch.setattr(bench, "bench_transformer_lm", boom)
+        monkeypatch.setattr(bench, "bench_decode",
+                            lambda: ran.append(1) or {
+                                "metric": "decode", "value": 1.0,
+                                "unit": "t/s"})
+        bench.main(["--rows", "transformer,decode"])
+        assert ran == [1]
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "no tokens today" in agg["rows"][0]["error"]
+        assert agg["rows"][1]["value"] == 1.0
+
+    def test_probe_failure_dumps_postmortem(self, monkeypatch, capsys,
+                                            tmp_path):
+        """BENCH_r05 follow-up: init timeout leaves exception.json +
+        registry.json beside the structured error rows."""
+        monkeypatch.setenv("BIGDL_TPU_POSTMORTEM_DIR", str(tmp_path))
+        monkeypatch.setattr(
+            bench, "_probe_backend",
+            lambda timeout_s: (None, "jax backend init timed out after "
+                                     "120s (wedged TPU tunnel?)"))
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "headline,decode"])
+        assert ei.value.code == 3
+        lines = _parse_lines(capsys.readouterr().out)
+        for r in lines[-1]["rows"]:
+            assert "timed out" in r["error"]
+            assert r["postmortem"] == str(tmp_path)
+        import json as _json
+        with open(tmp_path / "exception.json") as f:
+            exc = _json.load(f)
+        assert "timed out" in exc["exception"]["message"]
+        assert (tmp_path / "registry.json").exists()
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:

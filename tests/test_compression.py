@@ -1,0 +1,232 @@
+"""Wire-codec property tests (ISSUE 7 satellite).
+
+Pins the contracts the sharded-update collectives rely on
+(parameters/compression.py, parallel/collective.py):
+
+- int8 quantize/dequantize error bounded by the per-row scale
+- stochastic rounding is unbiased (fixed PRNG key, CLT bound)
+- error-feedback residual conservation: quantized + residual == input
+- bf16 device codec is BIT-EXACT host-``compress`` parity (the
+  reference's truncated high-16-bits wire format)
+- the eager compressed collectives (AllReduceParameter wire_codec)
+  reduce correctly within codec error bounds
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.parameters.compression import (
+    FP16CompressedTensor, compress, decompress, compressed_add,
+    bf16_compress_device, bf16_decompress_device,
+    int8_quantize, int8_dequantize, get_codec, KNOWN_CODECS)
+
+
+class TestInt8Codec:
+    def test_error_bound_nearest(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(32, 256).astype(np.float32) *
+                        rs.uniform(0.1, 10, (32, 1)).astype(np.float32))
+        q, scale = int8_quantize(x)
+        out = int8_dequantize(q, scale)
+        # nearest rounding: |err| <= scale/2 per element
+        err = np.abs(np.asarray(out) - np.asarray(x))
+        assert (err <= np.asarray(scale)[:, None] * 0.5 + 1e-12).all()
+
+    def test_error_bound_stochastic(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(16, 128).astype(np.float32))
+        q, scale = int8_quantize(x, key=jax.random.PRNGKey(0))
+        err = np.abs(np.asarray(int8_dequantize(q, scale)) - np.asarray(x))
+        # stochastic rounding moves at most one level
+        assert (err <= np.asarray(scale)[:, None] * (1 + 1e-6)).all()
+
+    def test_range_and_dtype(self):
+        x = jnp.asarray(np.linspace(-5, 5, 512, dtype=np.float32)[None])
+        q, scale = int8_quantize(x, key=jax.random.PRNGKey(3))
+        assert q.dtype == jnp.int8
+        qs = np.asarray(q)
+        assert qs.min() >= -127 and qs.max() <= 127
+
+    def test_zero_row_is_exact(self):
+        q, scale = int8_quantize(jnp.zeros((4, 64)))
+        assert (np.asarray(int8_dequantize(q, scale)) == 0).all()
+
+    def test_stochastic_rounding_unbiased(self):
+        """E[dequant(quant(x))] == x: average the SAME vector quantized
+        under many fold_in streams of one fixed key; the sample mean
+        must converge at the CLT rate."""
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(1, 256).astype(np.float32))
+        base = jax.random.PRNGKey(1234)
+
+        def one(k):
+            q, s = int8_quantize(x, key=k)
+            return int8_dequantize(q, s)[0]
+
+        trials = 512
+        outs = jax.vmap(one)(jax.random.split(base, trials))
+        mean_err = np.asarray(jnp.mean(outs, axis=0)) - np.asarray(x[0])
+        scale = float(jnp.max(jnp.abs(x)) / 127.0)
+        # per-element stderr of a U[0,1) rounding is scale/sqrt(12*trials);
+        # 6 sigma over 256 elements keeps flakiness ~0
+        bound = 6.0 * scale / np.sqrt(12.0 * trials)
+        assert np.abs(mean_err).max() < bound, \
+            (np.abs(mean_err).max(), bound)
+
+    def test_error_feedback_residual_conservation(self):
+        """decode(encode(x)) + residual == x exactly as computed by the
+        sharded path: the residual is DEFINED as x - decode(encode(x)),
+        so conservation pins that the codec exposes exactly the
+        quantized value the wire carried (no hidden second rounding)."""
+        rs = np.random.RandomState(3)
+        codec = get_codec("int8")
+        x = jnp.asarray(rs.randn(8, 512).astype(np.float32))
+        enc = codec.encode(x, jax.random.PRNGKey(7))
+        deq = codec.decode(enc)
+        residual = x - deq
+        # conservation: wire value + residual reconstructs the input to
+        # f32 rounding (one subtract + one add of same-magnitude terms)
+        recon = np.asarray(deq, np.float64) + np.asarray(residual,
+                                                         np.float64)
+        np.testing.assert_allclose(recon, np.asarray(x, np.float64),
+                                   rtol=1e-6, atol=1e-7)
+        # and the residual is bounded by one quantization level
+        assert (np.abs(np.asarray(residual))
+                <= np.asarray(enc["scale"])[:, None] * (1 + 1e-6)).all()
+
+
+class TestBF16DeviceHostEquivalence:
+    def test_compress_bit_exact(self):
+        """Device bf16 codec == host compress() BIT-exactly, including
+        the reference's truncation semantics (NOT round-to-nearest)."""
+        rs = np.random.RandomState(4)
+        x = np.concatenate([
+            rs.randn(4096).astype(np.float32),
+            np.asarray([0.0, -0.0, 1.0, -1.0, np.inf, -np.inf,
+                        1e-38, -1e-38, 3.14159e20], np.float32)])
+        dev = np.asarray(bf16_compress_device(jnp.asarray(x)))
+        host = compress(x)
+        assert dev.dtype == np.uint16
+        assert np.array_equal(dev, host)
+
+    def test_decompress_bit_exact(self):
+        rs = np.random.RandomState(5)
+        comp = rs.randint(0, 2 ** 16, size=2048).astype(np.uint16)
+        # avoid NaN payloads (NaN != NaN under array_equal)
+        comp[(comp & 0x7F80) == 0x7F80] = 0
+        dev = np.asarray(bf16_decompress_device(jnp.asarray(comp)))
+        assert np.array_equal(dev, decompress(comp))
+
+    def test_codec_roundtrip_matches_host_roundtrip(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(1024).astype(np.float32)
+        codec = get_codec("bf16")
+        dev = np.asarray(codec.decode(codec.encode(jnp.asarray(x))))
+        assert np.array_equal(dev, decompress(compress(x)))
+
+    def test_host_compressed_add_still_reference_shaped(self):
+        """The 2016 object API keeps working beside the device codecs."""
+        a, b = (np.random.RandomState(7).randn(2, 64)
+                .astype(np.float32))
+        t = FP16CompressedTensor(a)
+        t.add(b)
+        want = compressed_add(compress(a), compress(b))
+        assert np.array_equal(np.frombuffer(t.bytes(), np.uint16), want)
+
+
+class TestCodecRegistry:
+    def test_known_names(self):
+        for name in KNOWN_CODECS:
+            c = get_codec(name)
+            assert c.name == name
+        assert get_codec(None) is None
+        c = get_codec("bf16")
+        assert get_codec(c) is c
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown wire codec"):
+            get_codec("fp8")
+
+    def test_wire_bytes_decreasing(self):
+        widths = [get_codec(n).wire_bytes_per_element
+                  for n in ("fp32", "bf16", "int8")]
+        assert widths == sorted(widths, reverse=True) == [4.0, 2.0, 1.0]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from bigdl_tpu.parallel import Engine
+    Engine.reset()
+    yield Engine.init()
+    Engine.reset()
+
+
+class TestEagerCompressedCollectives:
+    """AllReduceParameter wire_codec threading (collective.py ->
+    all_reduce.py): the reference's N-party protocol, compressed."""
+
+    def _contribs(self, n=8, size=100, seed=0):
+        rs = np.random.RandomState(seed)
+        return [rs.randn(size).astype(np.float32) for _ in range(n)]
+
+    def test_fp32_codec_exact(self, mesh):
+        from bigdl_tpu.parameters import AllReduceParameter
+        contribs = self._contribs()
+        p = AllReduceParameter(wire_codec="fp32")
+        out = np.asarray(p.put_gradients(
+            [jnp.asarray(c) for c in contribs]))[:100]
+        want = np.sum(np.stack(contribs), axis=0, dtype=np.float32)
+        np.testing.assert_allclose(out, want, rtol=1e-6, atol=1e-6)
+
+    def test_bf16_codec_bounded(self, mesh):
+        from bigdl_tpu.parameters import AllReduceParameter
+        contribs = self._contribs(seed=1)
+        p = AllReduceParameter(wire_codec="bf16")
+        out = np.asarray(p.put_gradients(
+            [jnp.asarray(c) for c in contribs]))[:100]
+        want = np.sum(np.stack(contribs), axis=0)
+        # each contribution bf16-truncated (2^-7 relative) + possibly
+        # bf16-accumulated partial sums (the reference's parAdd was
+        # lossier still: it re-truncated after every add)
+        bound = (np.sum(np.abs(np.stack(contribs)), axis=0) * 2 ** -7
+                 + 1e-6)
+        assert (np.abs(out - want) <= bound).all()
+
+    def test_int8_codec_bounded(self, mesh):
+        from bigdl_tpu.parameters import AllReduceParameter
+        contribs = self._contribs(seed=2)
+        p = AllReduceParameter(wire_codec="int8")
+        out = np.asarray(p.put_gradients(
+            [jnp.asarray(c) for c in contribs]))[:100]
+        want = np.sum(np.stack(contribs), axis=0)
+        # nearest rounding: <= scale/2 per contribution, summed
+        scales = [np.abs(c).max() / 127.0 for c in contribs]
+        bound = sum(scales) * 0.5 + 1e-6
+        assert np.abs(out - want).max() <= bound
+
+    def test_spelled_alias_and_reference_alias_agree(self, mesh):
+        from bigdl_tpu.parameters import AllReduceParameter
+        contribs = [jnp.asarray(c) for c in self._contribs(seed=3)]
+        p = AllReduceParameter(wire_dtype=None)
+        a = np.asarray(p.aggregate_gradient_partition(contribs))
+        b = np.asarray(p.aggregrate_gradient_partition(contribs))
+        assert np.array_equal(a, b)
+        want = np.sum([np.asarray(c) for c in contribs], axis=0)
+        np.testing.assert_allclose(a[:100], want, rtol=1e-5, atol=1e-5)
+
+    def test_get_weights_bf16_wire_matches_host_codec(self, mesh):
+        """Weight all-gather at bf16 wire == the host codec's
+        round-trip, element-exactly (pure data movement, no sums)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from bigdl_tpu.parameters import AllReduceParameter
+        rs = np.random.RandomState(8)
+        p = AllReduceParameter(wire_codec="bf16")
+        flat = p.init({"w": jnp.asarray(rs.randn(50).astype(np.float32))})
+        padded = jnp.concatenate([flat, jnp.zeros(6)])
+        sharded = jax.device_put(
+            padded, NamedSharding(mesh, P("data")))
+        out = np.asarray(p.get_weights(sharded)["w"])
+        want = decompress(compress(np.asarray(padded)))[:50]
+        assert np.array_equal(out, want)

@@ -1,0 +1,296 @@
+"""Fully sharded weight update contract (ISSUE 7 tentpole).
+
+On the 8-virtual-CPU-device mesh:
+
+- the IMPLICIT sharded update (``shard_weight_update=True``) is
+  BIT-IDENTICAL to the replicated update — params, optimizer state
+  (through the ZeRO-1-compatible checkpoint export) and the full loss
+  series — for both SGD-with-momentum and Adam
+- the int8 + error-feedback explicit path converges to a matching final
+  loss on a toy model, and its residual rides checkpoints
+- checkpoints cross layouts: a replicated checkpoint resumes into a
+  sharded run (and vice versa) with a bit-identical continuation
+- conflicting configurations are refused loudly
+- the wire-compressed step's static HLO accounting shows the promised
+  wire-byte reductions (bf16 ~2x, int8 >= 3x over fp32)
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample, SampleToBatch, array
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils import file as bfile
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def make_dataset(n=256, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    return array([Sample(x[i], y[i]) for i in range(n)], num_shards=1)
+
+
+def make_mlp():
+    return nn.Sequential(nn.Linear(2, 32), nn.Tanh(),
+                         nn.Linear(32, 2), nn.LogSoftMax())
+
+
+def run_training(optim_factory, *, epochs=2, ckpt_dir=None,
+                 resume_from=None, **distri_kw):
+    """One DistriOptimizer run; returns (params, losses, saved_state).
+    ``resume_from``: a prior run's checkpoint dir — loads model + full
+    state (the test_checkpoint.py resume recipe)."""
+    Engine.reset()
+    Engine.init()
+    RandomGenerator.set_seed(7)
+    np.random.seed(3)
+    if resume_from is not None:
+        model = bfile.load_module(f"{resume_from}/model")
+    else:
+        model = make_mlp()
+    ds = make_dataset() >> SampleToBatch(64)
+    o = DistriOptimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion(), **distri_kw)
+    o.set_optim_method(optim_factory())
+    o.set_end_when(optim.max_epoch(epochs))
+    if ckpt_dir is not None:
+        o.set_checkpoint(str(ckpt_dir), optim.every_epoch())
+        o.overwrite_checkpoint()
+    if resume_from is not None:
+        o.set_state(bfile.load(f"{resume_from}/state"))
+    losses = []
+    orig = o._emit_step
+
+    def spy(e, loss):
+        losses.append(loss)
+        orig(e, loss)
+
+    o._emit_step = spy
+    trained = o.optimize()
+    saved = bfile.load(f"{ckpt_dir}/state") if ckpt_dir is not None \
+        else None
+    return trained.params, losses, saved
+
+
+def assert_tree_bit_identical(a, b, what=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), (what, len(la), len(lb))
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.shape == y.shape and x.dtype == y.dtype, (what, x, y)
+        if x.dtype == np.float32:
+            assert np.array_equal(x.view(np.uint32),
+                                  y.view(np.uint32)), \
+                (what, np.abs(x - y).max())
+        else:
+            assert np.array_equal(x, y), what
+
+
+class TestBitIdenticalToReplicated:
+    """Acceptance: uncompressed sharded update == replicated update,
+    bitwise, for params + optimizer state + loss series."""
+
+    @pytest.mark.parametrize("name,factory", [
+        ("sgd_momentum", lambda: optim.SGD(learning_rate=0.5,
+                                           momentum=0.9,
+                                           weight_decay=1e-4)),
+        ("adam", lambda: optim.Adam(learning_rate=0.05,
+                                    weight_decay=1e-4)),
+    ])
+    def test_bit_identical(self, name, factory, tmp_path):
+        p_ref, l_ref, s_ref = run_training(
+            factory, ckpt_dir=tmp_path / "ref")
+        p_sh, l_sh, s_sh = run_training(
+            factory, ckpt_dir=tmp_path / "sh", shard_weight_update=True)
+        assert len(l_ref) == len(l_sh) > 0
+        assert l_ref == l_sh, f"{name}: loss series diverged"
+        assert_tree_bit_identical(p_ref, p_sh, f"{name} params")
+        # optimizer state through the ZeRO-1-compatible export: the
+        # sharded checkpoint is params-shaped, directly comparable
+        assert_tree_bit_identical(s_ref["opt_state"], s_sh["opt_state"],
+                                  f"{name} opt state")
+
+
+class TestInt8ErrorFeedback:
+    def test_converges_and_ef_rides_checkpoint(self, tmp_path):
+        factory = lambda: optim.SGD(learning_rate=0.5, momentum=0.9)
+        _, l_ref, _ = run_training(factory, epochs=3)
+        _, l_int8, saved = run_training(
+            factory, epochs=3, ckpt_dir=tmp_path / "i8",
+            wire_codec="int8")
+        assert len(l_int8) == len(l_ref)
+        # lossy wire + per-shard loss semantics: the final loss must
+        # land on the replicated trajectory within tolerance
+        assert abs(l_int8[-1] - l_ref[-1]) < 0.05, (l_int8[-1], l_ref[-1])
+        ef = saved["opt_state"]["ef_residual"]
+        assert isinstance(ef, dict) and len(ef) >= 1
+        for v in ef.values():
+            arr = np.asarray(v)
+            assert arr.ndim == 2 and arr.shape[0] == 8  # (N, S_b)
+            assert np.abs(arr).max() > 0  # the residual is live
+
+    def test_int8_checkpoint_resume_bit_identical(self, tmp_path):
+        """Stop after epoch 2, resume (EF + rng + data position ride the
+        checkpoint) — the continuation replays the uninterrupted run
+        exactly."""
+        factory = lambda: optim.SGD(learning_rate=0.5, momentum=0.9)
+        _, l_full, _ = run_training(factory, epochs=3,
+                                    wire_codec="int8")
+        _, l_head, _ = run_training(factory, epochs=2,
+                                    ckpt_dir=tmp_path / "ck",
+                                    wire_codec="int8")
+        _, l_tail, _ = run_training(factory, epochs=3,
+                                    resume_from=tmp_path / "ck",
+                                    wire_codec="int8")
+        assert l_head == l_full[:len(l_head)]
+        assert l_tail == l_full[len(l_head):]
+
+
+class TestCheckpointCrossLayout:
+    def test_replicated_checkpoint_resumes_sharded(self, tmp_path):
+        """ZeRO-1-compatible layout: a replicated run's checkpoint feeds
+        a sharded continuation bit-identically (and the other way)."""
+        factory = lambda: optim.SGD(learning_rate=0.5, momentum=0.9)
+        p_full, l_full, _ = run_training(factory, epochs=2)
+        _, l_head, _ = run_training(factory, epochs=1,
+                                    ckpt_dir=tmp_path / "ck")
+        p_sh, l_sh, _ = run_training(factory, epochs=2,
+                                     resume_from=tmp_path / "ck",
+                                     shard_weight_update=True)
+        p_re, l_re, _ = run_training(factory, epochs=2,
+                                     resume_from=tmp_path / "ck")
+        assert l_sh == l_re == l_full[len(l_head):]
+        assert_tree_bit_identical(p_sh, p_re, "sharded resume params")
+        assert_tree_bit_identical(p_sh, p_full, "vs uninterrupted")
+
+    def test_sharded_checkpoint_resumes_replicated(self, tmp_path):
+        factory = lambda: optim.SGD(learning_rate=0.5, momentum=0.9)
+        _, l_full, _ = run_training(factory, epochs=2)
+        _, l_head, _ = run_training(factory, epochs=1,
+                                    ckpt_dir=tmp_path / "ck",
+                                    shard_weight_update=True)
+        p_re, l_re, _ = run_training(factory, epochs=2,
+                                     resume_from=tmp_path / "ck")
+        assert l_re == l_full[len(l_head):]
+
+
+class TestRefusals:
+    def _opt(self, **kw):
+        Engine.init()
+        model = make_mlp()
+        ds = make_dataset() >> SampleToBatch(64)
+        o = DistriOptimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion(), **kw)
+        o.set_end_when(optim.max_iteration(1))
+        return o
+
+    def test_unknown_codec(self):
+        with pytest.raises(ValueError, match="unknown wire codec"):
+            self._opt(wire_codec="fp8")
+
+    def test_tensor_parallel_conflict(self):
+        Engine.reset()
+        Engine.init(axes={"data": 4, "model": 2})
+        o = self._opt(shard_weight_update=True, tensor_parallel=True)
+        with pytest.raises(ValueError, match="tensor_parallel"):
+            o.optimize()
+
+    def test_zero1_conflict(self):
+        o = self._opt(shard_weight_update=True, shard_optim_state=True)
+        with pytest.raises(ValueError, match="subsumes"):
+            o.optimize()
+
+    def test_pad_partial_batches_with_codec(self):
+        o = self._opt(wire_codec="int8")
+        o.set_input_pipeline(pad_partial_batches=True)
+        with pytest.raises(ValueError, match="pad_partial_batches"):
+            o.optimize()
+
+    def test_per_param_hyper_tree(self):
+        o = self._opt(shard_weight_update=True)
+        model_params_shaped = {"0": {"weight": 0.1, "bias": 0.2}}
+        o.set_optim_method(optim.SGD(learning_rate=0.5,
+                                     learning_rates=model_params_shaped))
+        with pytest.raises(ValueError, match="params-shaped"):
+            o.optimize()
+
+    def test_local_optimizer_inert(self):
+        """The base setter threads everywhere; the local path has no
+        collectives and must train fine with the setting on."""
+        RandomGenerator.set_seed(1)
+        model = make_mlp()
+        ds = make_dataset() >> SampleToBatch(64)
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_sharded_update(True, wire_codec="int8")
+        o.set_end_when(optim.max_iteration(2))
+        o.optimize()  # must not raise
+
+
+class TestGradientBuckets:
+    def test_partition_and_roundtrip(self):
+        from bigdl_tpu.parameters.all_reduce import GradientBuckets
+        rs = np.random.RandomState(0)
+        tree = {"a": rs.randn(300, 10).astype(np.float32),
+                "b": rs.randn(33).astype(np.float32),
+                "c": rs.randn(64, 64).astype(np.float32)}
+        gb = GradientBuckets(tree, bucket_bytes=8192, n_shards=8)
+        flat = gb.flatten(tree)
+        assert set(flat) == set(gb.keys)
+        for k, v in flat.items():
+            assert v.shape[0] % 8 == 0
+            assert v.shape[0] == gb.padded_sizes[k]
+        back = gb.unflatten(flat)
+        for k in tree:
+            assert np.array_equal(np.asarray(back[k]), tree[k])
+
+    def test_reverse_order_and_size_target(self):
+        """Buckets follow reverse leaf order (backward-readiness) and
+        close at the byte target."""
+        from bigdl_tpu.parameters.all_reduce import GradientBuckets
+        tree = {f"l{i:02d}": np.zeros(1024, np.float32)
+                for i in range(8)}  # 4 KB per leaf
+        gb = GradientBuckets(tree, bucket_bytes=8192, n_shards=4)
+        assert len(gb) == 4  # 2 leaves per 8 KB bucket
+        # first bucket holds the LAST leaves
+        first = gb._buckets[0]["idxs"]
+        assert first == [7, 6]
+
+    def test_dtype_homogeneous(self):
+        from bigdl_tpu.parameters.all_reduce import GradientBuckets
+        tree = {"a": np.zeros(10, np.float32),
+                "b": np.zeros(10, np.float64),
+                "c": np.zeros(10, np.float64)}
+        gb = GradientBuckets(tree, bucket_bytes=1 << 20, n_shards=2)
+        for b in gb._buckets:
+            dts = {gb._dtypes[i] for i in b["idxs"]}
+            assert len(dts) == 1
+
+
+class TestWireBytesAccounting:
+    def test_int8_reduction_at_least_3x(self):
+        """Acceptance: the compiled explicit step's static HLO shows
+        >= 3x fewer wire bytes for int8 vs fp32 at unchanged step
+        semantics (same geometry, same collectives)."""
+        Engine.init()
+        from bigdl_tpu.optim.sharded_update import wire_bytes_probe
+        r = wire_bytes_probe(d_in=64, d_hidden=256, layers=2,
+                             batch=128, bucket_kb=256)
+        red = r["reduction_vs_fp32"]
+        assert red["int8"] >= 3.0, r
+        assert red["bf16"] >= 1.9, r
+        assert r["wire_bytes_per_chip"]["fp32"] > 0
+        # both phases (reduce + gather) present for every codec
+        assert all(v >= 2 for v in r["ops"].values()), r["ops"]
