@@ -225,6 +225,141 @@ def bench_train_mfu():
     }
 
 
+# cold-start probe geometries: model -> (input shape, classes). The
+# headline Inception geometry is the bench workload; lenet5 is the
+# fast geometry the contract tests exercise end to end.
+_COLD_START_GEOMETRIES = {
+    "inception_v1": ((3, 224, 224), 1000),
+    "lenet5": ((1, 28, 28), 10),
+}
+
+
+def _cold_start_probe_main(cache_dir: str, model_name: str,
+                           batch: int = 2) -> None:
+    """--cold-start-probe subprocess entry: build the train step through
+    the AOT-cache pipeline (tuning/aot_cache.py), run ONE step, and emit
+    the phase timings. First run against an empty ``cache_dir`` pays the
+    XLA compile; a second process against the same dir loads the
+    serialized executable instead."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.tuning.aot_cache import AOTCache, StepCompiler
+    if jax.default_backend() == "tpu":
+        # the bench policy. On CPU, bf16 EMULATION makes the one
+        # executed step dominate both phases and mask the
+        # construction-time difference being measured — f32 (the
+        # default policy) keeps the probe about compile vs load there
+        _set_bf16_policy()
+    t0 = time.perf_counter()
+    shape, classes = _COLD_START_GEOMETRIES[model_name]
+    if model_name == "lenet5":
+        from bigdl_tpu import models, nn
+        from bigdl_tpu.optim import SGD
+        model = models.LeNet5(classes)
+        model.materialize(jax.random.PRNGKey(0))
+        model.training()
+        criterion = nn.ClassNLLCriterion()
+        optim = SGD(learning_rate=0.0898, momentum=0.9)
+        params, mstate = model.params, model.state
+        opt_state = optim.init_state(params)
+
+        def train_step(params, mstate, opt_state, rng, data, labels):
+            def loss_fn(p):
+                y, st = model.apply(p, mstate, data, training=True,
+                                    rng=rng)
+                return criterion.apply(y, labels), st
+            (loss, st), g = jax.value_and_grad(loss_fn,
+                                               has_aux=True)(params)
+            p2, o2 = optim.update(g, params, opt_state)
+            return p2, st, o2, loss
+    else:
+        _, params, mstate, opt_state, train_step = _convnet_pieces(
+            model_name)
+    host = np.random.default_rng(0)
+    data = jnp.asarray(host.standard_normal((batch,) + shape,
+                                            np.float32))
+    labels = jnp.asarray(host.integers(1, classes + 1, size=(batch,)))
+    rng = jax.random.PRNGKey(0)
+    setup_s = time.perf_counter() - t0
+
+    cache = AOTCache(cache_dir)
+    pipeline = StepCompiler(
+        jax.jit(train_step, donate_argnums=(0, 1, 2)),
+        name="cold_start_probe", cache=cache, donate_argnums=(0, 1, 2),
+        extra=f"bench cold-start probe v1 {model_name} b{batch}")
+    # start-to-first-step for the phase the cache controls: step
+    # construction (lower+compile on a cold dir, deserialize on a warm
+    # one) plus the first executed step, host-synced
+    t1 = time.perf_counter()
+    args = (params, mstate, opt_state, rng, data, labels)
+    compiled, _ = pipeline.get((data.shape, labels.shape), args)
+    params, mstate, opt_state, loss = compiled(*args)
+    loss_v = float(jax.device_get(loss))
+    first_step_s = time.perf_counter() - t1
+    _emit({"first_step_s": first_step_s, "setup_s": setup_s,
+           "loss": loss_v, "cache_hits": cache.hits,
+           "cache_misses": cache.misses})
+
+
+def bench_compile_cold_start(model: str = "inception_v1",
+                             batch: int = 2,
+                             cache_dir: str | None = None):
+    """Worker start-to-first-step with a cold vs warmed AOT executable
+    cache (ISSUE 8): the same probe workload runs in two fresh
+    subprocesses sharing one cache directory — the first compiles and
+    serializes, the second deserializes. ``value`` is the speedup of
+    the phase the cache controls (step construction + first step);
+    model/data setup time is reported alongside so the whole-process
+    ratio stays honest. The probe batch is small so the one EXECUTED
+    step does not mask the construction-time difference on slow
+    backends. Children run on the CPU backend (like the wire probe —
+    the parent may hold the TPU), which is the conservative side: TPU
+    compiles are longer, deserializes are not."""
+    import subprocess
+    import tempfile
+    cache_dir = cache_dir or tempfile.mkdtemp(
+        prefix="bigdl_tpu_aot_bench_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = {}
+    for phase in ("cold", "warm"):
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cold-start-probe", cache_dir,
+             "--cold-start-model", model,
+             "--cold-start-batch", str(batch)],
+            capture_output=True, text=True, timeout=1200, env=env)
+        payload = None
+        for line in p.stdout.splitlines():
+            if line.startswith("{"):
+                payload = json.loads(line)
+        if payload is None:
+            tail = (p.stderr or "").strip().splitlines()[-3:]
+            raise RuntimeError(
+                f"cold-start {phase} probe rc={p.returncode}: "
+                + (" | ".join(tail) or "no output"))
+        out[phase] = payload
+    cold, warm = out["cold"], out["warm"]
+    ratio = cold["first_step_s"] / max(warm["first_step_s"], 1e-9)
+    wall_cold = cold["setup_s"] + cold["first_step_s"]
+    wall_warm = warm["setup_s"] + warm["first_step_s"]
+    return {
+        "metric": "compile_cold_start",
+        "value": round(ratio, 2),
+        "unit": "x (cold / warm start-to-first-step)",
+        "cold_first_step_s": round(cold["first_step_s"], 3),
+        "warm_first_step_s": round(warm["first_step_s"], 3),
+        "setup_s": round(warm["setup_s"], 3),
+        "wall_ratio_incl_setup": round(wall_cold /
+                                       max(wall_warm, 1e-9), 2),
+        "warm_cache_hits": warm["cache_hits"],
+        "warm_cache_misses": warm["cache_misses"],
+        "loss_bit_identical": cold["loss"] == warm["loss"],
+        "probe_model": model,
+        "cache_dir": cache_dir,
+    }
+
+
 def _wire_probe_geometry() -> dict:
     return dict(d_in=256, d_hidden=1024, layers=3, batch=512,
                 bucket_kb=512)
@@ -968,7 +1103,8 @@ def main(argv=None):
                              "decode,decode_ragged,decode_spec,"
                              "input_pipeline,serving_ttft,"
                              "serving_tokens_per_sec,train_mfu,"
-                             "collective_wire_bytes_per_step")
+                             "collective_wire_bytes_per_step,"
+                             "compile_cold_start")
     parser.add_argument("--probe-timeout", type=float,
                         # BENCH_r05: a wedged TPU tunnel hung backend init
                         # for the full 300 s — fail fast instead. The
@@ -993,6 +1129,13 @@ def main(argv=None):
                         help=argparse.SUPPRESS)   # subprocess entry
     parser.add_argument("--wire-probe", action="store_true",
                         help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--cold-start-probe", default=None,
+                        metavar="CACHE_DIR",
+                        help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--cold-start-model", default="inception_v1",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--cold-start-batch", type=int, default=16,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.host_probe is not None:
         _emit({"host_pipeline_img_per_sec":
@@ -1000,6 +1143,11 @@ def main(argv=None):
         return
     if args.wire_probe:
         _wire_probe_main()
+        return
+    if args.cold_start_probe is not None:
+        _cold_start_probe_main(args.cold_start_probe,
+                               args.cold_start_model,
+                               args.cold_start_batch)
         return
     global _metrics_server
     if args.serve_metrics is not None:
@@ -1058,13 +1206,14 @@ def _run(args):
                 "decode", "decode_ragged", "decode_spec",
                 "input_pipeline", "serving_ttft",
                 "serving_tokens_per_sec",
-                "collective_wire_bytes_per_step"]
+                "collective_wire_bytes_per_step",
+                "compile_cold_start"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
              "decode_ragged", "decode_spec", "input_pipeline",
              "serving_ttft", "serving_tokens_per_sec", "train_mfu",
-             "collective_wire_bytes_per_step"}
+             "collective_wire_bytes_per_step", "compile_cold_start"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -1100,6 +1249,7 @@ def _run(args):
         "headline": _headline_row,
         "train_mfu": bench_train_mfu,
         "collective_wire_bytes_per_step": bench_collective_wire_bytes,
+        "compile_cold_start": bench_compile_cold_start,
         "inception_v2": lambda: bench_convnet_synthetic("inception_v2"),
         "real": lambda: bench_real_data(0.0),
         "real_cached": lambda: bench_real_data(2.0),

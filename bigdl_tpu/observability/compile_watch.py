@@ -45,8 +45,9 @@ import logging
 import threading
 
 __all__ = ["CompileWatch", "default_watch", "watch", "note_compile",
-           "record_executable", "executable_stats", "signature_of",
-           "table", "reset", "DEFAULT_STORM_THRESHOLD"]
+           "note_cache_hit", "note_cache_miss", "record_executable",
+           "executable_stats", "signature_of", "table", "reset",
+           "DEFAULT_STORM_THRESHOLD"]
 
 logger = logging.getLogger("bigdl_tpu.observability.compile_watch")
 
@@ -235,6 +236,34 @@ class CompileWatch:
                 "Newest shape diff: %s", name, n_sigs, threshold, diff)
         return new
 
+    def note_cache_hit(self, name: str) -> None:
+        """One AOT-cache hit for ``name`` (tuning/aot_cache.py): the
+        executable was deserialized instead of compiled."""
+        with self._lock:
+            e = self._entry(name)
+            e["cache_hits"] = e.get("cache_hits", 0) + 1
+        self._reg().counter(
+            "tuning_cache_hits_total",
+            "AOT executable cache hits (deserialized, not compiled)",
+            labelnames=("name",)).inc(name=name)
+        self._trace().instant("aot cache hit", cat="compile_watch",
+                              watch=name)
+
+    def note_cache_miss(self, name: str, reason: str) -> None:
+        """One AOT-cache miss for ``name`` with its reason (absent /
+        deserialize_failed / ...) — the caller falls back to a fresh
+        compile."""
+        with self._lock:
+            e = self._entry(name)
+            e["cache_misses"] = e.get("cache_misses", 0) + 1
+        self._reg().counter(
+            "tuning_cache_misses_total",
+            "AOT executable cache misses (fresh compile follows)",
+            labelnames=("name",)).inc(name=name)
+        self._trace().instant("aot cache miss", cat="compile_watch",
+                              watch=name, reason=reason)
+        logger.info("tuning_cache_miss name=%s reason=%s", name, reason)
+
     def note_compile(self, name: str, signature, executable=None):
         """Record a compile the caller performed itself (AOT
         ``.lower().compile()`` paths). ``signature`` may be any
@@ -308,6 +337,8 @@ class CompileWatch:
                 out[name] = {
                     "calls": e["calls"], "compiles": e["compiles"],
                     "storms": e["storms"],
+                    "cache_hits": e.get("cache_hits", 0),
+                    "cache_misses": e.get("cache_misses", 0),
                     "signatures": [
                         {"signature": ["=".join(p) for p in sig],
                          "calls": count}
@@ -367,6 +398,14 @@ def watch(fn, *, name=None, storm_threshold=None, stats=True):
 
 def note_compile(name, signature, executable=None):
     return _DEFAULT.note_compile(name, signature, executable)
+
+
+def note_cache_hit(name):
+    return _DEFAULT.note_cache_hit(name)
+
+
+def note_cache_miss(name, reason):
+    return _DEFAULT.note_cache_miss(name, reason)
 
 
 def record_executable(name, executable):

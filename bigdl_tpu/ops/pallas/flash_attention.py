@@ -28,6 +28,7 @@ kernel whenever shapes allow).
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -36,35 +37,87 @@ from jax.experimental import pallas as pl
 __all__ = ["flash_attention", "flash_attention_with_lse",
            "flash_supported"]
 
+logger = logging.getLogger("bigdl_tpu.ops")
+
 # block-size menu: largest tile dividing the sequence wins — bigger tiles
 # amortize grid overhead and keep the MXU busy (512x1024 measured 2.7x the
-# 128x128 fwd at S=4096 on v5e); VMEM peak stays ~4 MB (s+p f32 tiles)
+# 128x128 fwd at S=4096 on v5e); VMEM peak stays ~4 MB (s+p f32 tiles).
+# The menu is the FALLBACK: a measured winner in the tuning record store
+# (bigdl_tpu/tuning/) for this (sq, skv, device kind) takes precedence,
+# and sequences no menu entry divides fall back to generated divisors
+# (_divisor_fallback) before giving up.
 _Q_BLOCKS = (512, 256, 128)
 _K_BLOCKS = (1024, 512, 256, 128)
 
 
-def _pick_blocks(sq: int, skv: int) -> tuple[int, int]:
-    bq = next((b for b in _Q_BLOCKS if sq % b == 0), None)
-    bk = next((b for b in _K_BLOCKS if skv % b == 0), None)
+def _tuned_blocks(sq: int, skv: int) -> tuple[int, int] | None:
+    """Autotuned (BQ, BK) for this geometry on this device kind, if a
+    record exists and is still legal for the shapes (a stale record —
+    e.g. tuned for a different sequence — is ignored with a warning,
+    never an error)."""
+    from bigdl_tpu.tuning.records import default_records
+    cfg = default_records().lookup("flash_attention",
+                                   {"sq": sq, "skv": skv})
+    if not cfg:
+        return None
+    try:
+        bq, bk = int(cfg["bq"]), int(cfg["bk"])
+    except (KeyError, TypeError, ValueError):
+        bq = bk = 0
+    if bq >= 8 and bk >= 8 and sq % bq == 0 and skv % bk == 0:
+        return bq, bk
+    logger.warning("ignoring illegal flash_attention tuning record "
+                   "%s for sq=%d skv=%d", cfg, sq, skv)
+    return None
+
+
+def _divisor_fallback(s: int, cap: int) -> int | None:
+    """Largest tile legally dividing ``s`` when no menu entry does:
+    multiples of 16 (the bf16 sublane tile — legal for f32 too) from
+    ``cap`` down to 128. E.g. s=320 -> 160, s=384 -> 384."""
+    top = min(cap, s)
+    for b in range(top - top % 16, 127, -16):
+        if s % b == 0:
+            return b
+    return None
+
+
+def _blocks_or_none(sq: int, skv: int) -> tuple[int, int] | None:
+    tuned = _tuned_blocks(sq, skv)
+    if tuned is not None:
+        return tuned
+    bq = next((b for b in _Q_BLOCKS if sq % b == 0), None) \
+        or _divisor_fallback(sq, _Q_BLOCKS[0])
+    bk = next((b for b in _K_BLOCKS if skv % b == 0), None) \
+        or _divisor_fallback(skv, _K_BLOCKS[0])
     if bq is None or bk is None:
-        raise ValueError(
-            f"flash_attention needs sequence lengths divisible by "
-            f"{_Q_BLOCKS[-1]}; got q_seq={sq}, kv_seq={skv} "
-            f"(use dot_product_attention's XLA path for ragged shapes)")
+        return None
     return bq, bk
+
+
+def _pick_blocks(sq: int, skv: int) -> tuple[int, int]:
+    picked = _blocks_or_none(sq, skv)
+    if picked is None:
+        raise ValueError(
+            f"flash_attention needs sequence lengths with a tile "
+            f"divisor >= 128 (multiple of 16); got q_seq={sq}, "
+            f"kv_seq={skv} "
+            f"(use dot_product_attention's XLA path for ragged shapes)")
+    return picked
 
 _NEG = -1e9  # finite mask value, matches parallel/sequence.py
 
 
 def flash_supported(q, k) -> bool:
-    """Kernel constraints: TPU backend, block-divisible sequence lengths,
-    a head dim Mosaic tiles cleanly. D=64 — the most common transformer
-    geometry — engages the kernel (round 3: Mosaic pads the 64-lane
-    minor dim internally; measured faster than the XLA fallback, which
-    the old ``d % 128`` guard silently forced)."""
+    """Kernel constraints: TPU backend, sequence lengths ``_pick_blocks``
+    can tile (menu, tuned record, or generated divisor — this predicate
+    and the picker share ``_blocks_or_none``, so supported == will not
+    raise), and a head dim Mosaic tiles cleanly. D=64 — the most common
+    transformer geometry — engages the kernel (round 3: Mosaic pads the
+    64-lane minor dim internally; measured faster than the XLA fallback,
+    which the old ``d % 128`` guard silently forced)."""
     return (jax.default_backend() == "tpu"
-            and q.shape[1] % _Q_BLOCKS[-1] == 0
-            and k.shape[1] % _K_BLOCKS[-1] == 0
+            and _blocks_or_none(q.shape[1], k.shape[1]) is not None
             and q.shape[-1] % 64 == 0)
 
 
@@ -304,9 +357,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """Tiled online-softmax attention over (B, S, H, D).
 
     Drop-in for ``dot_product_attention`` (zero offsets); differentiable
-    via the fused FlashAttention-2 backward. Requires S divisible by 128
-    and head_dim a multiple of 128 lanes (``flash_supported``); tile
-    sizes then scale up with S (``_pick_blocks``).
+    via the fused FlashAttention-2 backward. Requires sequence lengths
+    ``_pick_blocks`` can tile (a divisor >= 128 that is a multiple of
+    16) and a head_dim multiple of 64 (``flash_supported``); tile sizes
+    scale up with S from the static menu unless an autotuned record
+    (``bigdl_tpu/tuning``) overrides them.
     """
     o, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                     interpret=interpret)

@@ -25,6 +25,7 @@ This is a training-path op for big-vocab LMs; the module-level
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -32,15 +33,37 @@ from jax.experimental import pallas as pl
 
 __all__ = ["linear_cross_entropy", "linear_ce_supported"]
 
+logger = logging.getLogger("bigdl_tpu.ops")
+
 # token/vocab tiles: the (BT, BV) f32 logits tile plus double-buffered
 # W tiles must fit the 16 MB VMEM budget — 512x1024 keeps the dh kernel
-# at ~8 MB with bf16 W at D=512 (1024x2048 OOMed on v5e)
+# at ~8 MB with bf16 W at D=512 (1024x2048 OOMed on v5e). The menu is
+# the fallback — an autotuned record (bigdl_tpu/tuning) for this
+# (tokens, vocab, device kind) wins when one exists and is legal.
 _T_BLOCKS = (512, 256, 128)
 _V_BLOCKS = (1024, 512, 256, 128)
 
 
 def _pick(n, menu):
     return next((b for b in menu if n % b == 0), None)
+
+
+def _pick_tiles(n: int, v: int) -> tuple[int, int]:
+    """(BT, BV) for (tokens, vocab): tuned record first, static menu
+    otherwise. Used identically by forward and both backward kernels so
+    a tuning record retiles the whole op."""
+    from bigdl_tpu.tuning.records import default_records
+    cfg = default_records().lookup("fused_ce", {"n": n, "v": v})
+    if cfg:
+        try:
+            bt, bv = int(cfg["bt"]), int(cfg["bv"])
+        except (KeyError, TypeError, ValueError):
+            bt = bv = 0
+        if bt >= 8 and bv >= 128 and n % bt == 0 and v % bv == 0:
+            return bt, bv
+        logger.warning("ignoring illegal fused_ce tuning record %s "
+                       "for n=%d v=%d", cfg, n, v)
+    return _pick(n, _T_BLOCKS), _pick(v, _V_BLOCKS)
 
 
 def _tiles_ok(h, w) -> bool:
@@ -155,7 +178,7 @@ def _forward(h, w, b, targets, interpret):
     from jax.experimental.pallas import tpu as pltpu
     n, d = h.shape
     v = w.shape[0]
-    bt, bv = _pick(n, _T_BLOCKS), _pick(v, _V_BLOCKS)
+    bt, bv = _pick_tiles(n, v)
     nt, nv = n // bt, v // bv
     h_spec, w_spec, b_spec, t_spec = _specs(bt, bv, d)
     nll, lse = pl.pallas_call(
@@ -181,7 +204,7 @@ def _linear_ce_bwd(interpret, res, g):
     h, w, b, targets, lse = res
     n, d = h.shape
     v = w.shape[0]
-    bt, bv = _pick(n, _T_BLOCKS), _pick(v, _V_BLOCKS)
+    bt, bv = _pick_tiles(n, v)
     nt, nv = n // bt, v // bv
     h_spec, w_spec, b_spec, t_spec = _specs(bt, bv, d)
     g2 = g.reshape(n, 1).astype(jnp.float32)

@@ -38,6 +38,7 @@ In-model effect on the Inception-v1 bench: 4316 -> 4993 img/s
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +60,20 @@ _TEMP_BUDGET = 8 * 192 * 256 * 4    # bytes per f32 temp at the swept max
 
 
 def _pick_hw_tile(c: int, n: int) -> int:
+    # an autotuned winner for this (C, N, device kind) overrides the
+    # static sweep (bigdl_tpu/tuning); illegal records fall through
+    from bigdl_tpu.tuning.records import default_records
+    cfg = default_records().lookup("lrn", {"c": c, "n": n})
+    if cfg:
+        try:
+            ht = int(cfg["ht"])
+        except (KeyError, TypeError, ValueError):
+            ht = 0
+        if 1 <= ht <= 64:
+            return ht
+        logging.getLogger("bigdl_tpu.ops").warning(
+            "ignoring illegal lrn tuning record %s for c=%d n=%d",
+            cfg, c, n)
     ht = _HW_TILE
     while ht > 1 and ht * c * n * 4 > _TEMP_BUDGET:
         ht //= 2

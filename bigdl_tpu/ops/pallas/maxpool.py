@@ -45,6 +45,7 @@ in-kernel (x -> -inf, dy -> 0, reproducing SAME padding).
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -134,8 +135,22 @@ def _bwd_kernel(x_ref, xt_ref, xb_ref, y_ref, yt_ref, yb_ref,
         (2 + h_t, 2 + w_) + acc.shape[2:]).astype(dx_ref.dtype)
 
 
-def _bwd_call(x, y, g, interpret):
-    hw_h, w_, c, n = x.shape        # (H, W, C, N) view
+def _pick_tiles(hw_h: int, n: int) -> tuple[int, int]:
+    """(H-tile, N-tile): tuned record for this (H, N, device kind)
+    first, the swept static defaults otherwise."""
+    from bigdl_tpu.tuning.records import default_records
+    cfg = default_records().lookup("maxpool3x3s1", {"h": hw_h, "n": n})
+    if cfg:
+        try:
+            h_t, n_t = int(cfg["h_t"]), int(cfg["n_t"])
+        except (KeyError, TypeError, ValueError):
+            h_t = n_t = 0
+        if (1 <= h_t <= hw_h and hw_h % h_t == 0
+                and 1 <= n_t <= n and n % n_t == 0):
+            return h_t, n_t
+        logging.getLogger("bigdl_tpu.ops").warning(
+            "ignoring illegal maxpool tuning record %s for h=%d n=%d",
+            cfg, hw_h, n)
     # in-kernel temps are f32 (Mosaic can't compare bf16 vectors), so H
     # tiles stay small; odd H (the 7x7 pools) runs whole-plane
     if hw_h % _H_TILE == 0:
@@ -144,9 +159,14 @@ def _bwd_call(x, y, g, interpret):
         h_t = 2
     else:
         h_t = hw_h
+    return h_t, min(n, _N_TILE)
+
+
+def _bwd_call(x, y, g, interpret):
+    hw_h, w_, c, n = x.shape        # (H, W, C, N) view
+    h_t, n_t = _pick_tiles(hw_h, n)
     n_h = pl.cdiv(hw_h, h_t)
     c_t = 8
-    n_t = min(n, _N_TILE)
     grid = (n_h, c // c_t, n // n_t)
 
     def main_spec(rows):

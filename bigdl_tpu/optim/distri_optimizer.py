@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.observability import compile_watch, trace
+from bigdl_tpu.observability import trace
 from bigdl_tpu.optim.optimizer import Optimizer, _clip_gradients
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 
@@ -50,7 +50,7 @@ class DistriOptimizer(Optimizer):
     def __init__(self, model, dataset, criterion, batch_size=None, *,
                  mesh=None, shard_optim_state: bool = False,
                  shard_weight_update: bool = False, wire_codec=None,
-                 bucket_mb: float = 4.0,
+                 bucket_mb: float | None = None,
                  tensor_parallel: bool | str = False,
                  sequence_parallel: bool | str = False, **kw):
         super().__init__(model, dataset, criterion, batch_size, **kw)
@@ -61,10 +61,13 @@ class DistriOptimizer(Optimizer):
         # state per replica, all-gather params; wire_codec None keeps
         # the bit-identical implicit construction, "fp32"/"bf16"/"int8"
         # run explicit (compressed) per-shard collectives
+        # bucket_mb None = resolve at run time: the autotuned record for
+        # this (param count, data-axis size), else the 4 MB default
+        # (optim/sharded_update.py tuned_bucket_mb)
         if shard_weight_update or wire_codec is not None:
             self.set_sharded_update(True, wire_codec=wire_codec,
                                     bucket_mb=bucket_mb)
-        else:
+        elif bucket_mb is not None:
             self.bucket_mb = float(bucket_mb)
         # True / axis name: store params sharded over the mesh 'model'
         # axis and let XLA's SPMD partitioner split the math
@@ -193,6 +196,12 @@ class DistriOptimizer(Optimizer):
             self.optim_method
         mesh = self.mesh or get_mesh()
         n_shards = int(np.prod(mesh.devices.shape))
+        if self.tensor_parallel or self.shard_optim_state:
+            # params/optimizer-state leaves carry mesh shardings on these
+            # paths: the concat-grouped small-leaf update miscompiles
+            # under GSPMD (values summed over the data axis — see
+            # SGD.group_small_leaves); force the per-leaf form
+            optim.group_small_leaves = False
         model.materialize()
         model.training()
         params, mstate = model.params, model.state
@@ -344,9 +353,16 @@ class DistriOptimizer(Optimizer):
             donate_argnums=(0, 1, 2),
             in_shardings=in_shardings,
             out_shardings=(param_shard, repl, opt_shard, repl))
-        compiled_steps = {}    # batch shape -> AOT executable (partial
-                               # final batches recompile, like jit would);
-                               # collective accounting reads the first HLO
+        # explicit lower -> compile -> cache pipeline
+        # (tuning/aot_cache.py): one executable per batch shape (partial
+        # final batches recompile, like jit would), loaded from the
+        # persistent AOT cache on a warm restart instead of recompiling;
+        # collective accounting reads the first executable's HLO
+        from bigdl_tpu.tuning.aot_cache import StepCompiler
+        step_pipeline = StepCompiler(
+            jit_step, name="distri_train_step",
+            cache=self._aot_cache() or False, mesh=mesh,
+            donate_argnums=(0, 1, 2), extra=self._step_key_extra())
 
         def eval_apply(params, mstate, data):
             if self.input_transform is not None:
@@ -446,21 +462,15 @@ class DistriOptimizer(Optimizer):
                 if use_mask:
                     step_args += (jnp.asarray(global_n, jnp.int32),)
                 shape_key = (data.shape, labels.shape)
-                compiled_this_iter = shape_key not in compiled_steps
-                if compiled_this_iter:
-                    with trace.span("compile step",
-                                    shape=str(shape_key)):
-                        compiled = jit_step.lower(
-                            params, mstate, opt_state,
-                            *step_args).compile()
-                    if not compiled_steps:
-                        self._account_collectives(compiled, n_shards)
-                    compiled_steps[shape_key] = compiled
-                    # XLA compile/memory telemetry straight off the AOT
-                    # executable — compile count, FLOPs, peak HBM land in
-                    # the registry (observability/compile_watch.py)
-                    compile_watch.note_compile("distri_train_step",
-                                               shape_key, compiled)
+                compiled_this_iter = shape_key not in step_pipeline
+                # lower/compile (or AOT-cache load) on first sight of a
+                # shape; compile counts, executable FLOPs and peak HBM
+                # land in the registry either way
+                # (observability/compile_watch.py)
+                compiled, _ = step_pipeline.get(
+                    shape_key, (params, mstate, opt_state) + step_args)
+                if compiled_this_iter and len(step_pipeline) == 1:
+                    self._account_collectives(compiled, n_shards)
                 with trace.span("device step"):
                     # dispatch only — loss stays on device; the packed
                     # readback happens at drain time (docs/PERFORMANCE.md).
@@ -468,9 +478,8 @@ class DistriOptimizer(Optimizer):
                     # compute/aggregate phases fuse inside the jitted
                     # step, so what's measurable is input wait vs device
                     # step (see metrics.py)
-                    params, mstate, opt_state, loss = \
-                        compiled_steps[shape_key](
-                            params, mstate, opt_state, *step_args)
+                    params, mstate, opt_state, loss = compiled(
+                        params, mstate, opt_state, *step_args)
                 t2 = time.perf_counter()
                 self._telemetry_step()
                 n = global_n  # records consumed across all hosts

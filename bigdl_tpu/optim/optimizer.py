@@ -139,7 +139,14 @@ class Optimizer:
         # collectives, so the setting is accepted and inert there
         self.shard_weight_update = False
         self.wire_codec = None
-        self.bucket_mb = 4.0
+        # None = resolve per run: the autotuned record for this
+        # (param count, shard count) when one exists, else the 4 MB
+        # default (optim/sharded_update.py tuned_bucket_mb)
+        self.bucket_mb = None
+        # persistent AOT executable cache (tuning/aot_cache.py):
+        # "env" = $BIGDL_TPU_AOT_CACHE_DIR when set, else off;
+        # set_aot_cache() overrides either way
+        self._aot_cache_cfg = "env"
         # overlapped input pipeline (dataset/prefetch.py): batches are
         # assembled + device-placed on a worker thread, `depth` ahead of
         # the loop; 0 = the synchronous path (docs/PERFORMANCE.md)
@@ -301,6 +308,64 @@ class Optimizer:
                 raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
             self.bucket_mb = float(bucket_mb)
         return self
+
+    def set_aot_cache(self, cache):
+        """Configure the persistent AOT executable cache
+        (``tuning/aot_cache.py``, docs/PERFORMANCE.md): train-step
+        construction becomes an explicit lower → compile → cache
+        pipeline, and a restarting worker whose cache directory is warm
+        LOADS its compiled step (~ms) instead of recompiling it
+        (seconds to minutes) — results are bit-identical either way,
+        and any unreadable/stale entry falls back to a fresh compile.
+
+        ``cache``: a directory path, an ``AOTCache``, or ``None`` to
+        disable (overriding ``$BIGDL_TPU_AOT_CACHE_DIR``, which
+        otherwise applies when this method was never called). Returns
+        self."""
+        if isinstance(cache, str):
+            from bigdl_tpu.tuning.aot_cache import AOTCache
+            cache = AOTCache(cache)
+        self._aot_cache_cfg = cache
+        return self
+
+    def _aot_cache(self):
+        """The effective cache for this run (None = caching off)."""
+        if self._aot_cache_cfg == "env":
+            from bigdl_tpu.tuning.aot_cache import env_cache
+            return env_cache()
+        return self._aot_cache_cfg
+
+    def _step_key_extra(self) -> tuple:
+        """Program-identity key material for the AOT executable cache.
+        The abstract shape signature alone cannot tell two programs
+        with identical shapes apart, and jit-constant hyperparameters
+        (learning rate, clip bounds, dtype policy) are compiled into
+        the executable — so they all key the cache. ``stable_repr``
+        strips object addresses so the material matches across worker
+        processes."""
+        from bigdl_tpu.tensor import get_policy
+        from bigdl_tpu.tuning.aot_cache import stable_repr
+        optim = self.optim_method
+        transform = None
+        if self.input_transform is not None:
+            fn = self.input_transform
+            transform = getattr(fn, "__qualname__", None) or repr(fn)
+            try:        # a lambda's qualname alone would collide
+                import hashlib
+                import inspect
+                transform += ":" + hashlib.sha1(
+                    inspect.getsource(fn).encode()).hexdigest()[:12]
+            except Exception:
+                pass
+        return (stable_repr(self.model), stable_repr(self.criterion),
+                type(optim).__name__, stable_repr(vars(optim)),
+                stable_repr(self.grad_clip), stable_repr(get_policy()),
+                transform, self._pad_stage is not None,
+                self.shard_weight_update, self.wire_codec,
+                self.bucket_mb,
+                getattr(self, "tensor_parallel", None),
+                getattr(self, "sequence_parallel", None),
+                getattr(self, "shard_optim_state", None))
 
     def set_metrics_server(self, port: int = 0, host: str = "127.0.0.1",
                            *, liveness_deadline: float = 600.0):
@@ -809,12 +874,20 @@ class LocalOptimizer(Optimizer):
                                                      opt_state)
             return new_params, new_mstate, new_opt_state, loss
 
-        # stats=False: pure signature counting — the hot loop must add
-        # zero tracing work; retraces (partial final batches and worse)
-        # still land in compile_watch_compiles_total and storm-warn
-        jit_step = compile_watch.watch(
+        # explicit lower -> compile -> cache step construction
+        # (tuning/aot_cache.py): executables are built per batch
+        # signature OUTSIDE the hot loop's dispatch path, optionally
+        # loaded from the persistent AOT cache (set_aot_cache /
+        # $BIGDL_TPU_AOT_CACHE_DIR) so a restarting worker skips XLA;
+        # per-call signature counting keeps compile_watch's
+        # calls/compiles/storm accounting identical to the old
+        # implicit-jit path
+        from bigdl_tpu.tuning.aot_cache import StepCompiler
+        step_pipeline = StepCompiler(
             jax.jit(train_step, donate_argnums=(0, 1, 2)),
-            name="local_train_step", stats=False)
+            name="local_train_step", cache=self._aot_cache() or False,
+            donate_argnums=(0, 1, 2), extra=self._step_key_extra(),
+            count_calls=True)
 
         def eval_apply(params, mstate, data):
             if self.input_transform is not None:
@@ -862,10 +935,16 @@ class LocalOptimizer(Optimizer):
                              jnp.asarray(driver_state["epoch"], jnp.int32))
                 if use_mask:
                     step_args += (jnp.asarray(n, jnp.int32),)
+                # quick dispatch key: only the batch varies between
+                # iterations (params/opt state keep their avals through
+                # donation) — two leaves to hash, full signature only on
+                # a miss inside the pipeline
+                quick = compile_watch.signature_of((data, labels))
+                compiled, _ = step_pipeline.get(quick, step_args)
                 with trace.span("device step"):
                     # dispatch only — loss stays on device; the packed
                     # readback happens at drain time (docs/PERFORMANCE.md)
-                    params, mstate, opt_state, loss = jit_step(*step_args)
+                    params, mstate, opt_state, loss = compiled(*step_args)
                 t2 = time.perf_counter()
                 self._telemetry_step()
                 count_this_epoch += n

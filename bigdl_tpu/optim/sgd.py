@@ -217,6 +217,18 @@ class SGD(OptimMethod):
 
     _SMALL_LEAF = 16384   # elements; see _grouped_update below
 
+    #: gate for the concatenated small-leaf update. DistriOptimizer sets
+    #: this False when parameters or optimizer state are mesh-sharded
+    #: (tensor parallelism, ZeRO-1): concatenating leaves with mixed
+    #: NamedShardings and slicing the fused result back was measured to
+    #: MISCOMPILE under GSPMD — every updated value came back multiplied
+    #: by the data-axis size (reproduced on the 8-device CPU mesh; the
+    #: per-leaf form is correct). Grouping is only a kernel-launch
+    #: optimization, and under sharded layouts the concat would force a
+    #: resharding round-trip anyway, so skipping it there is also the
+    #: faster choice.
+    group_small_leaves: bool = True
+
     def update(self, grads, params, state):
         clr = self.current_lr(state)
         wd = self.weight_decay
@@ -315,7 +327,11 @@ class SGD(OptimMethod):
         are updated on one concatenated vector instead; big leaves keep
         the per-leaf form so XLA's in-place buffer donation still covers
         ~99% of the parameter bytes (the all-leaf flat form was measured
-        2x slower — see the rejection note above)."""
+        2x slower — see the rejection note above). Disabled entirely
+        (``group_small_leaves=False``) when leaves carry mesh shardings —
+        see the attribute note."""
+        if not self.group_small_leaves:
+            return None
         leaves_p, treedef = jax.tree.flatten(params)
         # full structure check (tree.map would raise; flatten-order
         # pairing on a mismatched tree would silently mis-assign)

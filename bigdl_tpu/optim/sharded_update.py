@@ -59,9 +59,37 @@ from bigdl_tpu.parallel.collective import shard_map
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
-__all__ = ["ShardedWeightUpdate", "wire_bytes_probe"]
+__all__ = ["ShardedWeightUpdate", "wire_bytes_probe", "tuned_bucket_mb",
+           "DEFAULT_BUCKET_MB"]
 
 EF_KEY = "ef_residual"
+
+DEFAULT_BUCKET_MB = 4.0
+
+
+def tuned_bucket_mb(n_params: int, n_shards: int) -> float:
+    """Gradient-bucket size for this (parameter count, shard count):
+    the autotuned record when one exists (``tune`` over
+    ``bucket_mb_candidates``, bigdl_tpu/tuning), the measured 4 MB
+    default otherwise. Small buckets overlap more of the backward; big
+    buckets amortize collective latency — the sweet spot moves with
+    model depth and mesh size, which is why it is a tuning-record knob
+    rather than a constant."""
+    from bigdl_tpu.tuning.records import default_records
+    cfg = default_records().lookup(
+        "sharded_update", {"params": n_params, "shards": n_shards})
+    if cfg:
+        try:
+            mb = float(cfg["bucket_mb"])
+        except (KeyError, TypeError, ValueError):
+            mb = 0.0
+        if mb > 0:
+            logger.info("sharded update: tuned bucket_mb=%.1f for "
+                        "%d params on %d shards", mb, n_params, n_shards)
+            return mb
+        logger.warning("ignoring illegal sharded_update tuning record "
+                       "%s", cfg)
+    return DEFAULT_BUCKET_MB
 
 
 class ShardedWeightUpdate:
@@ -71,12 +99,16 @@ class ShardedWeightUpdate:
     class owns the layout algebra."""
 
     def __init__(self, mesh, optim, params, *, axis: str = "data",
-                 wire_codec=None, bucket_mb: float = 4.0):
+                 wire_codec=None, bucket_mb: float | None = None):
         self.mesh = mesh
         self.axis = axis
         self.n = int(mesh.shape[axis])
         self.optim = optim
         self.codec = get_codec(wire_codec)
+        if bucket_mb is None:
+            n_params = sum(int(l.size) for l in jax.tree.leaves(params))
+            bucket_mb = tuned_bucket_mb(n_params, self.n)
+        self.bucket_mb = float(bucket_mb)
         self.buckets = GradientBuckets(
             params, bucket_bytes=int(bucket_mb * (1 << 20)),
             n_shards=self.n)

@@ -1,0 +1,50 @@
+"""Autotuning + persistent AOT executable cache (ROADMAP item 4).
+
+Two halves, one goal — a restarting worker reaches full speed in
+seconds, not minutes:
+
+- :mod:`tuning.autotuner` / :mod:`tuning.records`: measured search
+  (``tune``) over Pallas tile configs, batch geometry and sharded-update
+  bucket sizes, pruned by a static VMEM/cost model before anything
+  compiles; winners persist to a JSON record store keyed by (kernel,
+  abstract-shape signature, device kind) that the kernels' block
+  pickers consult before their static menus.
+- :mod:`tuning.aot_cache`: the explicit ``lower -> compile -> cache``
+  step-construction pipeline (``StepCompiler``) with serialized
+  executables (``AOTCache``) keyed by (abstract signature, mesh,
+  donation mask, library+device fingerprint), with a fresh-compile
+  backstop on any load failure.
+
+See docs/PERFORMANCE.md "Autotuning & AOT executable cache".
+
+HOST-ONLY package (jaxlint JX5): jax is only imported lazily inside
+functions that measure or compile.
+"""
+from bigdl_tpu.tuning.aot_cache import (AOTCache, StepCompiler,
+                                        cache_key, fingerprint,
+                                        stable_repr)
+from bigdl_tpu.tuning.autotuner import (TuneResult, VMEM_BUDGET_BYTES,
+                                        batch_geometry_candidates,
+                                        bucket_mb_candidates,
+                                        flash_candidates,
+                                        flash_est_vmem,
+                                        fused_ce_candidates,
+                                        fused_ce_est_vmem,
+                                        lrn_candidates, lrn_est_vmem,
+                                        maxpool_candidates,
+                                        tile_divisors, tune)
+from bigdl_tpu.tuning.records import (TuningRecords, default_records,
+                                      device_kind, set_default_records,
+                                      signature_str)
+
+__all__ = [
+    "AOTCache", "StepCompiler", "cache_key", "fingerprint",
+    "stable_repr",
+    "TuneResult", "VMEM_BUDGET_BYTES", "tune", "tile_divisors",
+    "flash_candidates", "flash_est_vmem", "fused_ce_candidates",
+    "fused_ce_est_vmem", "lrn_candidates", "lrn_est_vmem",
+    "maxpool_candidates", "bucket_mb_candidates",
+    "batch_geometry_candidates",
+    "TuningRecords", "default_records", "set_default_records",
+    "device_kind", "signature_str",
+]

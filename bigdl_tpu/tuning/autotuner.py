@@ -1,0 +1,291 @@
+"""Measured autotuning over the stack's performance knobs (ROADMAP 4).
+
+TVM-style search (arXiv:1802.04799) scaled down to the knobs this repo
+actually has: Pallas tile configurations (flash attention BQ/BK, fused-CE
+token/vocab tiles, LRN spatial tiles, maxpool H/N tiles), batch geometry,
+and the sharded-update ``bucket_mb``. The search is MEASURED — each
+surviving candidate is compiled and timed on the live backend — but
+pruned and ordered first by a static cost model so obviously illegal or
+VMEM-overflowing candidates never compile:
+
+- ``est_vmem(config)`` estimates a candidate's peak VMEM footprint;
+  anything past ``vmem_budget`` is skipped without building (the menu
+  comments in ops/pallas/* record real OOMs at exactly these sizes).
+- ``seed_stats`` — the per-executable FLOPs/bytes table
+  ``observability.compile_watch.executable_stats`` extracts for the
+  incumbent configuration — feeds ``est_cost(config, stats)`` so the
+  most promising candidates measure first and ``max_candidates`` cuts
+  the tail of a bandwidth-dominated search space instead of a random
+  subset.
+
+Winners persist to :mod:`tuning.records` keyed by (kernel, abstract
+shape signature, device kind); the kernel block pickers consult that
+store before their static menus, so one tuning pass feeds the whole
+fleet.
+
+HOST-ONLY CONTRACT (jaxlint JX5): jax is imported lazily inside the
+measurement helpers only.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from bigdl_tpu.tuning.records import TuningRecords, default_records
+
+__all__ = ["tune", "TuneResult", "Measurement", "VMEM_BUDGET_BYTES",
+           "flash_candidates", "flash_est_vmem", "fused_ce_candidates",
+           "fused_ce_est_vmem", "lrn_candidates", "lrn_est_vmem",
+           "maxpool_candidates", "bucket_mb_candidates",
+           "batch_geometry_candidates", "tile_divisors"]
+
+logger = logging.getLogger("bigdl_tpu.tuning")
+
+#: per-core VMEM to budget tile temporaries against (16 MB on v4/v5e;
+#: the estimate is deliberately conservative — double-buffered inputs
+#: plus f32 scratch)
+VMEM_BUDGET_BYTES = 16 * (1 << 20)
+
+
+@dataclass
+class Measurement:
+    config: dict
+    time_s: float | None          # None when skipped/failed
+    skipped: str | None = None    # why it never ran (pruned/error)
+
+
+@dataclass
+class TuneResult:
+    config: dict                  # the winner
+    time_s: float
+    baseline_time_s: float | None
+    tie: bool                     # winner == baseline config
+    measurements: list[Measurement] = field(default_factory=list)
+    record_key: str | None = None
+
+
+def _sync(out) -> None:
+    """Force device completion of a candidate run. ``device_get`` is the
+    sanctioned real sync (block_until_ready is a no-op through the axon
+    tunnel, bench.py measured)."""
+    import jax
+    leaves = [l for l in jax.tree.leaves(out)
+              if hasattr(l, "dtype") and hasattr(l, "shape")]
+    if leaves:
+        jax.device_get(leaves[0])
+
+
+def tune(build, candidates, *, key, records: TuningRecords | None = None,
+         warmup: int = 1, iters: int = 3, est_vmem=None,
+         vmem_budget: int = VMEM_BUDGET_BYTES, seed_stats: dict | None
+         = None, est_cost=None, max_candidates: int | None = None,
+         baseline: dict | None = None, persist: bool = True
+         ) -> TuneResult:
+    """Measured search over ``candidates`` (dicts of knob values).
+
+    ``build(config)`` returns a zero-argument callable running the
+    workload once at that configuration (its first call may compile —
+    compile time is excluded by the ``warmup`` calls). ``key`` is the
+    ``(kernel, signature)`` pair the winner persists under.
+
+    Static pruning happens BEFORE ``build``: candidates whose
+    ``est_vmem(config)`` exceeds ``vmem_budget`` are skipped unbuilt,
+    and when ``est_cost(config, seed_stats)`` is given the survivors
+    measure in ascending predicted-cost order with ``max_candidates``
+    bounding the measured set (the cut is logged — never silent). A
+    candidate whose build/run raises is recorded as skipped with the
+    error, not fatal: an illegal tile is a pruning-model gap, not a
+    tuning failure.
+
+    ``baseline`` (e.g. the static-menu pick) is measured alongside; a
+    winner that IS the baseline is reported as a tie and logged.
+    Returns the :class:`TuneResult`; the winner is persisted to
+    ``records`` unless ``persist=False``.
+    """
+    kernel, sig = key
+    cands = [dict(c) for c in candidates]
+    if baseline is not None and baseline not in cands:
+        cands.append(dict(baseline))
+    measurements: list[Measurement] = []
+    runnable: list[dict] = []
+    for c in cands:
+        if est_vmem is not None:
+            try:
+                need = est_vmem(c)
+            except Exception as e:
+                measurements.append(Measurement(c, None,
+                                                f"est_vmem error: {e}"))
+                continue
+            if need is not None and need > vmem_budget:
+                measurements.append(Measurement(
+                    c, None, f"pruned: est VMEM {need / 2**20:.1f} MB > "
+                             f"budget {vmem_budget / 2**20:.1f} MB"))
+                continue
+        runnable.append(c)
+    if est_cost is not None:
+        runnable.sort(key=lambda c: est_cost(c, seed_stats))
+    if max_candidates is not None and len(runnable) > max_candidates:
+        cut = runnable[max_candidates:]
+        # the baseline must always measure, or the tie/beat verdict
+        # would compare against nothing
+        keep = runnable[:max_candidates]
+        if baseline is not None and baseline in cut:
+            keep.append(dict(baseline))
+            cut = [c for c in cut if c != baseline]
+        logger.info("tune(%s): measuring %d of %d candidates "
+                    "(cost-model cut dropped %d: %s)", kernel, len(keep),
+                    len(runnable), len(cut), cut)
+        for c in cut:
+            measurements.append(Measurement(c, None,
+                                            "pruned: cost-model cut"))
+        runnable = keep
+
+    best: Measurement | None = None
+    baseline_time = None
+    for c in runnable:
+        try:
+            fn = build(c)
+            for _ in range(max(warmup, 1)):   # first call pays compile
+                _sync(fn())
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(max(iters, 1)):
+                out = fn()
+            _sync(out)
+            dt = (time.perf_counter() - t0) / max(iters, 1)
+        except Exception as e:
+            measurements.append(Measurement(c, None,
+                                            f"{type(e).__name__}: {e}"))
+            logger.info("tune(%s): candidate %s failed: %s", kernel, c, e)
+            continue
+        m = Measurement(c, dt)
+        measurements.append(m)
+        if baseline is not None and c == baseline:
+            baseline_time = dt
+        if best is None or dt < best.time_s:
+            best = m
+    if best is None:
+        raise ValueError(
+            f"tune({kernel}): no candidate survived — "
+            f"{[m.skipped for m in measurements]}")
+
+    tie = baseline is not None and best.config == baseline
+    if tie:
+        logger.info("tune(%s, %s): TIE — measured winner equals the "
+                    "static default %s (%.3g s)", kernel, sig,
+                    best.config, best.time_s)
+    elif baseline_time is not None:
+        logger.info("tune(%s, %s): %s (%.3g s) beats static %s "
+                    "(%.3g s), %.2fx", kernel, sig, best.config,
+                    best.time_s, baseline, baseline_time,
+                    baseline_time / max(best.time_s, 1e-12))
+    rec_key = None
+    if persist:
+        store = records if records is not None else default_records()
+        rec_key = store.record(kernel, sig, best.config,
+                               score=best.time_s,
+                               meta={"iters": iters,
+                                     "measured": sum(
+                                         1 for m in measurements
+                                         if m.time_s is not None),
+                                     "tie_with_static": tie})
+    return TuneResult(config=dict(best.config), time_s=best.time_s,
+                      baseline_time_s=baseline_time, tie=tie,
+                      measurements=measurements, record_key=rec_key)
+
+
+# ---------------------------------------------------------------------------
+# candidate generators + VMEM estimators, one pair per tuned kernel.
+# The estimators model the f32 scratch + double-buffered block inputs of
+# the actual kernels (ops/pallas/*) — deliberately a slight OVERestimate
+# so the prune errs toward skipping a config that might have fit.
+# ---------------------------------------------------------------------------
+
+def tile_divisors(n: int, cap: int, floor: int = 128, step: int = 16
+                  ) -> list[int]:
+    """Tile sizes that legally divide ``n``: multiples of ``step`` (the
+    bf16 sublane tile — legal for f32 too) from ``cap`` down to
+    ``floor``, largest first."""
+    top = min(cap, n)
+    return [b for b in range(top - top % step, floor - 1, -step)
+            if n % b == 0]
+
+
+def flash_candidates(sq: int, skv: int, *, q_cap: int = 512,
+                     k_cap: int = 1024) -> list[dict]:
+    """(BQ, BK) grid for flash attention at (sq, skv): every legal
+    divisor pair, menu sizes included."""
+    return [{"bq": bq, "bk": bk}
+            for bq in tile_divisors(sq, q_cap)
+            for bk in tile_divisors(skv, k_cap)]
+
+
+def flash_est_vmem(d: int, dtype_bytes: int = 2):
+    """Forward-kernel footprint at head dim ``d``: s+p f32 tiles
+    (bq, bk), f32 acc (bq, d), double-buffered q/k/v blocks."""
+    def est(c: dict) -> int:
+        bq, bk = c["bq"], c["bk"]
+        f32 = 4
+        return (2 * bq * bk * f32 + bq * d * f32
+                + 2 * (bq * d + 2 * bk * d) * dtype_bytes)
+    return est
+
+
+def fused_ce_candidates(n: int, v: int, *, t_cap: int = 512,
+                        v_cap: int = 1024) -> list[dict]:
+    return [{"bt": bt, "bv": bv}
+            for bt in tile_divisors(n, t_cap)
+            for bv in tile_divisors(v, v_cap)]
+
+
+def fused_ce_est_vmem(d: int, dtype_bytes: int = 2):
+    """dW-kernel footprint (the fattest of the three): f32 logits tile
+    (bt, bv), f32 dw scratch (bv, d), double-buffered h/w blocks."""
+    def est(c: dict) -> int:
+        bt, bv = c["bt"], c["bv"]
+        f32 = 4
+        return (bt * bv * f32 + bv * d * f32
+                + 2 * (bt * d + bv * d) * dtype_bytes)
+    return est
+
+
+def lrn_candidates(hw: int) -> list[dict]:
+    """Spatial-row tiles for the LRN kernel: powers of two dividing the
+    plane (the swept menu was 1..16; >=16 OOMed at C=192, N=256 — the
+    estimator prunes those shapes per-geometry instead of globally)."""
+    return [{"ht": ht} for ht in (16, 8, 4, 2, 1) if hw % ht == 0]
+
+
+def lrn_est_vmem(c_dim: int, n: int):
+    def est(c: dict) -> int:
+        # ~4 live f32 (ht, C, N) temps in the backward (x, s, acc, dx)
+        return 4 * c["ht"] * c_dim * n * 4
+    return est
+
+
+def maxpool_candidates(h: int, n: int) -> list[dict]:
+    """H-tile / N-tile grid for the maxpool backward kernel."""
+    hts = [ht for ht in (8, 4, 2) if h % ht == 0] or [h]
+    nts = [nt for nt in (256, 128) if n % nt == 0] or [min(n, 256)]
+    return [{"h_t": ht, "n_t": nt} for ht in hts for nt in nts]
+
+
+def bucket_mb_candidates() -> list[dict]:
+    """Sharded-update gradient bucket sizes (optim/sharded_update.py):
+    small buckets overlap more of the backward, big buckets amortize
+    collective latency — the right point is model- and mesh-dependent."""
+    return [{"bucket_mb": mb} for mb in (1.0, 2.0, 4.0, 8.0, 16.0)]
+
+
+def batch_geometry_candidates(global_batch: int, n_shards: int,
+                              *, span: int = 2) -> list[dict]:
+    """Per-step batch geometries near ``global_batch`` that keep the
+    data-axis divisibility contract: halving/doubling within ``span``
+    octaves, shard-divisible only."""
+    out = []
+    for k in range(-span, span + 1):
+        b = int(global_batch * (2.0 ** k))
+        if b >= n_shards and b % n_shards == 0:
+            out.append({"batch": b})
+    return out

@@ -65,10 +65,14 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # lazy-import jax inside the functions that issue them.
 # serving/: the router/pool/prefix-cache plane is host orchestration
 # over the batcher API — device work stays inside the batchers it
-# drives (the ContinuousBatcher class itself is lazy-imported)
+# drives (the ContinuousBatcher class itself is lazy-imported).
+# tuning/: records/search/cache bookkeeping is host-side; the
+# measurement and lower/compile/serialize calls lazy-import jax inside
+# the functions that issue them
 HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
                       "bigdl_tpu/dataset/prefetch.py",
-                      "bigdl_tpu/serving/")
+                      "bigdl_tpu/serving/",
+                      "bigdl_tpu/tuning/")
 
 # the per-iteration-sync flavor of JX1 only applies to library code:
 # tests and dev tooling are host drivers that sync deliberately

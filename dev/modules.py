@@ -29,6 +29,7 @@ MODULES = {
                "tests/test_serving.py", "tests/test_perf_paths.py"],
     "observability": ["tests/test_observability.py",
                       "tests/test_telemetry.py"],
+    "tuning": ["tests/test_tuning.py"],
     "serving": ["tests/test_serving_router.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],

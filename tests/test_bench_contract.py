@@ -440,6 +440,52 @@ class TestBenchRecovery:
         assert (tmp_path / "registry.json").exists()
 
 
+class TestCompileColdStartRow:
+    """ISSUE 8 satellite: compile_cold_start — wall-clock to first step
+    with a cold vs warmed AOT executable cache, reported as the ratio —
+    rides the standard row/known/all contract."""
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        fake = {"metric": "compile_cold_start", "value": 12.5,
+                "unit": "x (cold / warm start-to-first-step)",
+                "cold_first_step_s": 10.0, "warm_first_step_s": 0.8,
+                "warm_cache_hits": 1, "loss_bit_identical": True}
+        monkeypatch.setattr(bench, "bench_compile_cold_start",
+                            lambda **kw: dict(fake))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "compile_cold_start",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "compile_cold_start"
+        assert lines[-1]["rows"][0]["value"] == 12.5
+        with open(out) as f:
+            assert "bench_compile_cold_start 12.5" in f.read()
+
+    def test_row_in_all(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "compile_cold_start" in [r["metric"] for r in agg["rows"]]
+
+    def test_real_probe_fast_geometry(self, tmp_path):
+        """A REAL two-subprocess cold/warm run on the fast lenet5
+        geometry: the warm worker must load (1 hit, 0 misses), be
+        faster, and replay the cold loss bit-identically."""
+        row = bench.bench_compile_cold_start(
+            model="lenet5", batch=32, cache_dir=str(tmp_path))
+        assert row["metric"] == "compile_cold_start"
+        assert row["warm_cache_hits"] == 1
+        assert row["warm_cache_misses"] == 0
+        assert row["loss_bit_identical"] is True
+        assert row["value"] > 1.0, row   # warm strictly faster
+        assert row["cold_first_step_s"] > row["warm_first_step_s"]
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:

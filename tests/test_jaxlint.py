@@ -419,6 +419,31 @@ class TestJX5HostOnlyImports:
         """, rel="bigdl_tpu/serving/replica_pool.py")
         assert out == []
 
+    def test_tuning_subsystem_is_host_only(self):
+        """ISSUE 8 satellite pin: bigdl_tpu/tuning/ (records, autotuner,
+        AOT cache) is host orchestration — a module-level jax import in
+        any of its modules is a JX5 finding (measurement and
+        lower/compile/serialize calls lazy-import jax), and the shipped
+        files are clean."""
+        for mod in ("__init__.py", "records.py", "autotuner.py",
+                    "aot_cache.py"):
+            rel = f"bigdl_tpu/tuning/{mod}"
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, "bigdl_tpu", "tuning", mod)
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+        # the sanctioned lazy-import shapes stay clean
+        out = lint("""
+            def load(self, key):
+                from jax.experimental import serialize_executable as se
+                return se.deserialize_and_load(*self._blob(key))
+        """, rel="bigdl_tpu/tuning/aot_cache.py")
+        assert out == []
+
     def test_telemetry_plane_modules_are_covered(self):
         """Satellite pin: the host-only prefix covers the telemetry
         plane — a module-level jax import in exporter.py /
