@@ -1048,6 +1048,68 @@ def bench_serving_tokens_per_sec(**kw):
     }
 
 
+def bench_serving_decode_hbm(**geometry):
+    """Static per-decode-step HBM accounting, dense view vs the Pallas
+    paged kernel (ISSUE 9 — the tentpole's measured receipt): lowers
+    one single-token decode step both ways in a CPU SUBPROCESS (same
+    pattern as ``collective_wire_bytes_per_step``; lowering only, no
+    execution, and the parent's TPU backend is never touched) and
+    reports (a) the view-sized gather materializations each compiled
+    HLO carries — exactly 2*layers for the dense path, ZERO for the
+    kernel — and (b) the static attention-traffic model: dense pays 3x
+    the (B, P*S, KV, D) view per k/v consumption, paged reads each
+    row's live pages once. ``value`` is the dense/paged reduction."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--decode-hbm-probe",
+         "--decode-hbm-geometry", json.dumps(geometry)],
+        capture_output=True, text=True, timeout=600, env=env)
+    payload = None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            payload = json.loads(line)
+    if payload is None:
+        tail = (out.stderr or "").strip().splitlines()[-2:]
+        raise RuntimeError(
+            f"decode-hbm probe subprocess rc={out.returncode}: "
+            + (" | ".join(tail) or "no output"))
+    mg = payload["materialized_gathers"]
+    ab = payload["attn_hbm_bytes"]
+    ex = payload["executable"]
+    return {
+        "metric": "serving_decode_hbm_bytes",
+        "value": round(payload["reduction"], 2),
+        "unit": "x (dense-view / paged attention HBM bytes per "
+                "decode step)",
+        "attn_hbm_bytes_dense": ab["dense"],
+        "attn_hbm_bytes_paged": ab["paged"],
+        "materialized_gather_ops_dense": mg["dense"]["ops"],
+        "materialized_gather_bytes_dense": mg["dense"]["bytes"],
+        "materialized_gather_ops_paged": mg["paged"]["ops"],
+        "materialized_gather_bytes_paged": mg["paged"]["bytes"],
+        "view_shape": payload["view_shape"],
+        "view_bytes": payload["view_bytes"],
+        "peak_view_bytes_per_layer_eliminated":
+            payload["peak_view_bytes_per_layer"],
+        "bytes_accessed_dense_exec": ex["dense"].get("bytes_accessed"),
+        "peak_hbm_bytes_dense_exec": ex["dense"].get("peak_hbm_bytes"),
+        # off-TPU the paged step compiles in interpreter mode, so its
+        # executable numbers describe the emulation; the static rows
+        # above are the backend-independent receipt
+        "paged_compiled_as": payload["paged_compiled_as"],
+        "geometry": payload["geometry"],
+    }
+
+
+def _decode_hbm_probe_main(geometry_json: str):
+    """--decode-hbm-probe subprocess entry: run the static accounting
+    on the CPU backend and emit the JSON payload. ``geometry_json``
+    overrides probe dimensions (the contract tests use a tiny one)."""
+    from bigdl_tpu.models.transformer.serving import decode_hbm_probe
+    _emit(decode_hbm_probe(**json.loads(geometry_json or "{}")))
+
+
 def _probe_backend(timeout_s: float):
     """Init the default jax backend in a SUBPROCESS with a hard timeout.
 
@@ -1077,6 +1139,128 @@ def _probe_backend(timeout_s: float):
     return p.stdout.strip(), None
 
 
+# ---------------------------------------------------------------------------
+# regression gate (ROADMAP item 5): compare this run's rows against a
+# recorded baseline with per-row thresholds; a real slowdown fails the
+# run with a distinct exit code.
+# ---------------------------------------------------------------------------
+
+#: a row passes while value >= baseline * min_ratio (higher-is-better)
+#: or value <= baseline / min_ratio (lower-is-better) — 20% headroom by
+#: default so scheduler noise does not flap the gate; tighten per row
+#: in the baseline file
+GATE_DEFAULT_MIN_RATIO = 0.8
+
+# metrics where a SMALLER value is the better one; everything else
+# (throughput-style rows) gates higher-is-better. Baseline entries can
+# override with an explicit "direction".
+_GATE_LOWER_IS_BETTER = {"serving_ttft"}
+
+GATE_EXIT_CODE = 4
+
+# row key -> emitted metric name, where they differ: a row that FAILS
+# mid-run is recorded under its row key, so the gate must recognize a
+# baselined metric behind either name
+_ROW_METRICS = {
+    "headline": "inception_v1_train_images_per_sec_per_chip",
+    "inception_v2": "inception_v2_train_images_per_sec_per_chip",
+    "resnet50": "resnet50_train_images_per_sec_per_chip",
+    "vgg16": "vgg16_train_images_per_sec_per_chip",
+    "real": "inception_v1_train_real_jpeg_images_per_sec_per_chip",
+    "real_cached":
+        "inception_v1_train_real_jpeg_cached_images_per_sec_per_chip",
+    "transformer": "transformer_lm_train_tokens_per_sec_per_chip",
+    "decode": "transformer_lm_decode_tokens_per_sec_per_chip",
+    "decode_ragged":
+        "transformer_lm_ragged_decode_tokens_per_sec_per_chip",
+    "decode_spec": "transformer_lm_speculative_decode_tokens_per_sec",
+    "input_pipeline": "input_pipeline_overlap",
+}
+_METRIC_TO_ROW = {v: k for k, v in _ROW_METRICS.items()}
+
+
+def _gate_check(path: str, rows_out: list[dict]) -> tuple[dict, bool]:
+    """Evaluate the recorded baseline at ``path`` against this run's
+    rows. Returns (gate row, ok). Only metrics present in BOTH the
+    baseline and the run are judged (the baseline may cover rows this
+    invocation did not request — reported as skipped, never silently
+    dropped); a baselined row that ERRORED this run is a failure."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        base = doc["rows"]
+        if not isinstance(base, dict):
+            raise ValueError("baseline 'rows' is not an object")
+    except Exception as e:
+        row = {"metric": "bench_gate", "value": 0.0, "unit": "1 = pass",
+               "baseline": path,
+               "error": f"unreadable baseline: {type(e).__name__}: {e}"}
+        return row, False
+    by_metric = {r.get("metric"): r for r in rows_out}
+    checked, skipped, failures = [], [], []
+    for metric, spec in sorted(base.items()):
+        row = by_metric.get(metric) \
+            or by_metric.get(_METRIC_TO_ROW.get(metric))
+        if row is None:
+            skipped.append(metric)
+            continue
+        if "error" in row:
+            failures.append({"metric": metric,
+                             "reason": f"row errored: {row['error']}"})
+            continue
+        val = row.get("value")
+        bval = float(spec["value"])
+        ratio = float(spec.get("min_ratio", GATE_DEFAULT_MIN_RATIO))
+        direction = spec.get(
+            "direction",
+            "lower" if metric in _GATE_LOWER_IS_BETTER else "higher")
+        checked.append(metric)
+        if not isinstance(val, (int, float)):
+            failures.append({"metric": metric,
+                             "reason": f"non-numeric value {val!r}"})
+            continue
+        if direction == "lower":
+            ok = val <= bval / max(ratio, 1e-9)
+            reason = (f"{val} > baseline {bval} / min_ratio {ratio} "
+                      f"(lower is better)")
+        else:
+            ok = val >= bval * ratio
+            reason = f"{val} < baseline {bval} * min_ratio {ratio}"
+        if not ok:
+            failures.append({"metric": metric, "value": val,
+                             "baseline": bval, "min_ratio": ratio,
+                             "direction": direction, "reason": reason})
+    row = {"metric": "bench_gate", "value": 0.0 if failures else 1.0,
+           "unit": "1 = pass", "baseline": path, "checked": checked,
+           "skipped": skipped, "failures": failures}
+    return row, not failures
+
+
+def _write_baseline(path: str, rows_out: list[dict]) -> None:
+    """Record this run as the new gate baseline: every successful
+    numeric row, with the default threshold and its direction spelled
+    out so the file is hand-editable."""
+    rows = {}
+    for r in rows_out:
+        val = r.get("value")
+        if ("error" in r or "metric" not in r
+                or r["metric"] in ("aggregate", "bench_gate")
+                or not isinstance(val, (int, float))):
+            continue
+        rows[r["metric"]] = {
+            "value": val,
+            "min_ratio": GATE_DEFAULT_MIN_RATIO,
+            "direction": ("lower" if r["metric"] in _GATE_LOWER_IS_BETTER
+                          else "higher"),
+            "unit": r.get("unit", ""),
+        }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "rows": rows}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    print(f"# gate baseline written to {path}", file=sys.stderr)
+
+
 # the driver's parser keeps only the LAST JSON line (BENCH_r03 lesson), so
 # after the per-row lines we re-emit everything in one aggregate line that
 # carries the headline fields at top level plus every row under "rows"
@@ -1104,7 +1288,16 @@ def main(argv=None):
                              "input_pipeline,serving_ttft,"
                              "serving_tokens_per_sec,train_mfu,"
                              "collective_wire_bytes_per_step,"
-                             "compile_cold_start")
+                             "compile_cold_start,"
+                             "serving_decode_hbm_bytes")
+    parser.add_argument("--gate", default=None, metavar="BASELINE_JSON",
+                        help="compare this run's rows against a "
+                             "recorded baseline (per-row thresholds); "
+                             f"a real slowdown exits {GATE_EXIT_CODE}")
+    parser.add_argument("--baseline-out", default=None, metavar="PATH",
+                        help="record this run's rows as the new gate "
+                             "baseline (written alongside "
+                             "--metrics-out)")
     parser.add_argument("--probe-timeout", type=float,
                         # BENCH_r05: a wedged TPU tunnel hung backend init
                         # for the full 300 s — fail fast instead. The
@@ -1129,6 +1322,10 @@ def main(argv=None):
                         help=argparse.SUPPRESS)   # subprocess entry
     parser.add_argument("--wire-probe", action="store_true",
                         help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--decode-hbm-probe", action="store_true",
+                        help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--decode-hbm-geometry", default="{}",
+                        help=argparse.SUPPRESS)
     parser.add_argument("--cold-start-probe", default=None,
                         metavar="CACHE_DIR",
                         help=argparse.SUPPRESS)   # subprocess entry
@@ -1143,6 +1340,9 @@ def main(argv=None):
         return
     if args.wire_probe:
         _wire_probe_main()
+        return
+    if args.decode_hbm_probe:
+        _decode_hbm_probe_main(args.decode_hbm_geometry)
         return
     if args.cold_start_probe is not None:
         _cold_start_probe_main(args.cold_start_probe,
@@ -1207,13 +1407,14 @@ def _run(args):
                 "input_pipeline", "serving_ttft",
                 "serving_tokens_per_sec",
                 "collective_wire_bytes_per_step",
-                "compile_cold_start"]
+                "compile_cold_start", "serving_decode_hbm_bytes"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
              "decode_ragged", "decode_spec", "input_pipeline",
              "serving_ttft", "serving_tokens_per_sec", "train_mfu",
-             "collective_wire_bytes_per_step", "compile_cold_start"}
+             "collective_wire_bytes_per_step", "compile_cold_start",
+             "serving_decode_hbm_bytes"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -1262,6 +1463,7 @@ def _run(args):
         "input_pipeline": bench_input_pipeline_overlap,
         "serving_ttft": bench_serving_ttft,
         "serving_tokens_per_sec": bench_serving_tokens_per_sec,
+        "serving_decode_hbm_bytes": bench_serving_decode_hbm,
     }
     rows_out: list[dict] = []
     headline_failed = False
@@ -1291,6 +1493,13 @@ def _run(args):
                     rows_out.append(r)
                     _emit(r)
                 break
+    gate_ok = True
+    if args.gate:
+        # the gate verdict rides INSIDE the aggregate (the driver keeps
+        # only the last JSON line) as well as its own structured row
+        gate_row, gate_ok = _gate_check(args.gate, rows_out)
+        rows_out.append(gate_row)
+        _emit(gate_row)
     _emit_aggregate(rows_out)
     if backend_died is not None:
         pm = _dump_bench_postmortem(RuntimeError(backend_died),
@@ -1298,6 +1507,8 @@ def _run(args):
         if pm:
             print(f"# postmortem: {pm}", file=sys.stderr)
         raise SystemExit(3)
+    if args.baseline_out:
+        _write_baseline(args.baseline_out, rows_out)
     if args.metrics_out:
         from bigdl_tpu.observability.registry import default_registry
         reg = default_registry()
@@ -1308,6 +1519,8 @@ def _run(args):
                 f.write(reg.expose())
         print(f"# metrics registry written to {args.metrics_out}",
               file=sys.stderr)
+    if not gate_ok:
+        raise SystemExit(GATE_EXIT_CODE)
     if headline_failed:
         raise SystemExit(2)
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import time
 
 import jax
@@ -47,8 +48,9 @@ from bigdl_tpu.observability.registry import default_registry
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
 __all__ = ["generate_ragged", "PagedKVCache", "paged_prefill",
-           "paged_decode", "speculative_generate", "ContinuousBatcher",
-           "KVSnapshot"]
+           "paged_decode", "paged_decode_step_stats",
+           "decode_hbm_probe", "speculative_generate",
+           "ContinuousBatcher", "KVSnapshot", "PAGED_KERNEL_ENV"]
 
 
 def _rope_rows(x, positions, theta: float = 10000.0):
@@ -98,11 +100,16 @@ def _attend_grouped(q, ck, cv, upto, num_heads, scale):
 
 
 def _ragged_block_step(bp, x, ck, cv, pos, num_heads, max_len,
-                       rope=False, num_kv_heads=None):
+                       rope=False, num_kv_heads=None,
+                       paged_kernel=None):
     """One TransformerBlock on a (B, T, E) slice whose LAST column sits at
     per-row absolute position ``pos`` (B,). T==1 decode or T==gamma+1
     speculative verify. Cache writes are per-row scatters; attention
-    masks per-row. Returns (x, ck, cv)."""
+    masks per-row. ``paged_kernel`` in ("pallas", "interpret") routes
+    the attention through the Pallas page-walk kernel, viewing the
+    dense (B, M, KV, D) cache as contiguous pages (free reshape) so
+    short rows skip their empty tail — the speculative path's half of
+    the decode-kernel switch. Returns (x, ck, cv)."""
     b, t, e = x.shape
     scale = (e // num_heads) ** -0.5
     q, k, v = _qkv(bp, x, num_heads, num_kv_heads)
@@ -114,7 +121,13 @@ def _ragged_block_step(bp, x, ck, cv, pos, num_heads, max_len,
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
     ck = ck.at[rows, cols].set(k.astype(ck.dtype), mode="drop")
     cv = cv.at[rows, cols].set(v.astype(cv.dtype), mode="drop")
-    o = _attend_grouped(q, ck, cv, cols, num_heads, scale)
+    if paged_kernel in ("pallas", "interpret"):
+        from bigdl_tpu.ops.pallas.paged_attention import \
+            dense_cache_attention
+        o = dense_cache_attention(q, ck, cv, pos - (t - 1), scale=scale,
+                                  interpret=(paged_kernel == "interpret"))
+    else:
+        o = _attend_grouped(q, ck, cv, cols, num_heads, scale)
     o = o.reshape(b, t, e).astype(x.dtype)
     x = x + _proj(bp["0"]["1"], "out", o).astype(activation_dtype())
     x = x + _ffn(bp["1"]["1"], _ln(bp["1"]["0"], x))
@@ -291,19 +304,73 @@ class PagedKVCache:
 
 def _paged_view(pool, table):
     """(num_pages, S, KV, D) pool + (B, P) table -> (B, P*S, KV, D)
-    gathered per-row cache view (the logical dense cache)."""
+    gathered per-row cache view (the logical dense cache). The
+    FALLBACK consumption of the pool: an O(B*P*S*KV*D) HBM
+    materialization per call — the Pallas paged kernel
+    (ops/pallas/paged_attention.py) replaces it on the decode hot
+    path; this stays as the off-TPU / explicitly-requested dense
+    path."""
     b, p = table.shape
     g = pool[table.reshape(-1)]                  # (B*P, S, KV, D)
     s, kv, d = pool.shape[1:]
     return g.reshape(b, p * s, kv, d)
 
 
+#: env override for the decode-kernel switch: "dense" | "pallas" |
+#: "interpret" | "auto" (auto = Pallas on TPU when the geometry is
+#: supported, dense-view otherwise)
+PAGED_KERNEL_ENV = "BIGDL_TPU_PAGED_KERNEL"
+
+_PAGED_KERNEL_MODES = ("auto", "dense", "pallas", "interpret")
+
+
+def _resolve_paged_kernel(mode, supported) -> str:
+    """Host-side resolution of the ``paged_kernel=`` switch to the
+    static trace-time choice: ``None``/"auto" consults
+    ``$BIGDL_TPU_PAGED_KERNEL`` then falls back to "pallas" iff
+    ``supported()`` says the compiled kernel is legal here (TPU
+    backend, tileable geometry), "dense" otherwise. Explicit modes are
+    respected as given — "interpret" is the CPU parity path the tests
+    pin."""
+    if mode is None:
+        mode = os.environ.get(PAGED_KERNEL_ENV) or "auto"
+    if mode not in _PAGED_KERNEL_MODES:
+        raise ValueError(f"paged_kernel must be one of "
+                         f"{_PAGED_KERNEL_MODES}, got {mode!r}")
+    if mode == "auto":
+        return "pallas" if supported() else "dense"
+    return mode
+
+
+def _pool_kernel_supported(cache) -> bool:
+    """auto-switch legality for this pool's geometry on the compiled
+    TPU path (the interpret path has no constraints)."""
+    from bigdl_tpu.ops.pallas.paged_attention import paged_supported
+    return paged_supported(cache.head_dim, cache.page_size)
+
+
+def _attend_paged(q, kp, vp, table, q_start, upto, num_heads, scale,
+                  kernel: str):
+    """One attention consumption of the page pool, switched: the
+    Pallas kernel walks the block table page-by-page (no dense view);
+    the dense path gathers ``_paged_view`` and reuses
+    ``_attend_grouped``. Both return (B, T, H, D) f32."""
+    if kernel in ("pallas", "interpret"):
+        from bigdl_tpu.ops.pallas.paged_attention import paged_attention
+        return paged_attention(q, kp, vp, table, q_start, scale=scale,
+                               interpret=(kernel == "interpret"))
+    ckv = _paged_view(kp, table)
+    cvv = _paged_view(vp, table)
+    return _attend_grouped(q, ckv, cvv, upto, num_heads, scale)
+
+
 @functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=(
     "num_layers", "num_heads", "page_size", "policy_key", "rope",
-    "num_kv_heads"))
+    "num_kv_heads", "paged_kernel"))
 def _paged_prefill_impl(params, kp, vp, table, prompt, lengths, *,
                         num_layers, num_heads, page_size, policy_key,
-                        rope=False, num_kv_heads=None):
+                        rope=False, num_kv_heads=None,
+                        paged_kernel="dense"):
     """Prefill right-padded prompts (B, Pmax) INTO the page pool.
 
     Column j of row i writes physical slot (table[i, j//S], j%S); padding
@@ -331,9 +398,12 @@ def _paged_prefill_impl(params, kp, vp, table, prompt, lengths, *,
             k.astype(kp[li].dtype), mode="drop")
         new_vp[li] = new_vp[li].at[phys, slot].set(
             v.astype(vp[li].dtype), mode="drop")
-        ckv = _paged_view(new_kp[li], table)
-        cvv = _paged_view(new_vp[li], table)
-        o = _attend_grouped(q, ckv, cvv, cols, num_heads, scale)
+        # prefill query columns are row-uniform (0..Pmax-1), so the
+        # kernel's q_start is zero for every row; padding columns
+        # produce junk either way (never read — see docstring)
+        o = _attend_paged(q, new_kp[li], new_vp[li], table,
+                          jnp.zeros((b,), jnp.int32), cols, num_heads,
+                          scale, paged_kernel)
         o = o.reshape(x.shape).astype(x.dtype)
         x = x + _proj(blocks[li]["0"]["1"], "out",
                       o).astype(activation_dtype())
@@ -344,7 +414,7 @@ def _paged_prefill_impl(params, kp, vp, table, prompt, lengths, *,
 
 
 def paged_prefill(model, cache: PagedKVCache, table, prompts, *,
-                  lengths=None, params=None):
+                  lengths=None, params=None, paged_kernel=None):
     """Prefill a mixed-length prompt batch into the paged pool.
 
     ``table``: (B, pages_per_seq) physical-page ids covering at least
@@ -353,9 +423,13 @@ def paged_prefill(model, cache: PagedKVCache, table, prompts, *,
     already right-padded (B, Pmax) array whose per-row true lengths are
     given explicitly (bucketed serving pads Pmax past the longest
     prompt so compilation count stays bounded; padding columns never
-    write pages or logits). Returns (greedy first tokens (B,),
-    lengths (B,)) — feed both straight into :func:`paged_decode`; pool
-    arrays inside ``cache`` are rebound."""
+    write pages or logits). ``paged_kernel``: the decode-kernel switch
+    ("auto"/None consults $BIGDL_TPU_PAGED_KERNEL, then picks the
+    Pallas page-walk kernel on TPU when legal and the dense
+    ``_paged_view`` path otherwise; "interpret" is the CPU parity
+    mode). Returns (greedy first tokens (B,), lengths (B,)) — feed
+    both straight into :func:`paged_decode`; pool arrays inside
+    ``cache`` are rebound."""
     params = model.params if params is None else params
     meta = model.lm_meta
     if lengths is None:
@@ -384,24 +458,26 @@ def paged_prefill(model, cache: PagedKVCache, table, prompts, *,
             f"{table.shape[1]} pages x {cache.page_size} slots "
             f"= {capacity}-token capacity")
     policy_key = (str(activation_dtype()), str(compute_dtype()))
+    kernel = _resolve_paged_kernel(
+        paged_kernel, lambda: _pool_kernel_supported(cache))
     first, kp, vp = _paged_prefill_impl(
         params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
         jnp.asarray(batch), jnp.asarray(lengths),
         num_layers=meta["num_layers"], num_heads=meta["num_heads"],
         page_size=cache.page_size, policy_key=policy_key,
         rope=meta.get("pos_encoding", "learned") == "rope",
-        num_kv_heads=meta.get("num_kv_heads"))
+        num_kv_heads=meta.get("num_kv_heads"), paged_kernel=kernel)
     cache.kp, cache.vp = kp, vp
     return first, lengths
 
 
 @functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=(
     "num_layers", "num_heads", "n_new", "page_size", "temperature",
-    "top_k", "policy_key", "rope", "num_kv_heads"))
+    "top_k", "policy_key", "rope", "num_kv_heads", "paged_kernel"))
 def _paged_decode_impl(params, kp, vp, table, lengths, tok0, rng, *,
                        num_layers, num_heads, n_new, page_size,
                        temperature, top_k, policy_key, rope=False,
-                       num_kv_heads=None):
+                       num_kv_heads=None, paged_kernel="dense"):
     """Scan ``n_new`` single-token steps through the paged pools.
 
     ``table`` (B, P) logical->physical page map, ``lengths`` (B,) tokens
@@ -429,9 +505,11 @@ def _paged_decode_impl(params, kp, vp, table, lengths, tok0, rng, *,
                 k[:, 0].astype(kp[li].dtype))
             new_vp[li] = vp[li].at[phys, slot].set(
                 v[:, 0].astype(vp[li].dtype))
-            ckv = _paged_view(new_kp[li], table)
-            cvv = _paged_view(new_vp[li], table)
-            o = _attend_grouped(q, ckv, cvv, cols, num_heads, scale)
+            # the single query column sits at per-row position
+            # ``lengths`` — the slot just written above
+            o = _attend_paged(q, new_kp[li], new_vp[li], table,
+                              lengths, cols, num_heads, scale,
+                              paged_kernel)
             o = o.reshape(x.shape).astype(x.dtype)
             x = x + _proj(blocks[li]["0"]["1"], "out",
                           o).astype(activation_dtype())
@@ -450,16 +528,19 @@ def _paged_decode_impl(params, kp, vp, table, lengths, tok0, rng, *,
 
 def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
                  n_new: int, *, config: GenerationConfig | None = None,
-                 rng=None, params=None):
+                 rng=None, params=None, paged_kernel=None):
     """Decode ``n_new`` tokens for every row through the paged pool.
 
     ``table``: (B, pages_per_seq) int32 physical-page ids from
     ``cache.alloc``; ``lengths``: (B,) tokens already cached (0 for a
     fresh row — its first "last token" is the prompt's last id after a
     ragged/dense prefill copied in, or the BOS id for from-scratch rows).
-    Returns (tokens (B, n_new), updated lengths); pool arrays inside
-    ``cache`` are replaced with the updated ones (functional update,
-    rebinding — old arrays are donated garbage)."""
+    ``paged_kernel``: "auto"/None (env-overridable) picks the Pallas
+    page-walk kernel on TPU when legal, the dense ``_paged_view`` path
+    otherwise; "dense"/"pallas"/"interpret" force a path. Returns
+    (tokens (B, n_new), updated lengths); pool arrays inside ``cache``
+    are replaced with the updated ones (functional update, rebinding —
+    old arrays are donated garbage)."""
     config = config or GenerationConfig(max_new_tokens=n_new)
     params = model.params if params is None else params
     meta = model.lm_meta
@@ -474,6 +555,8 @@ def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     policy_key = (str(activation_dtype()), str(compute_dtype()))
+    kernel = _resolve_paged_kernel(
+        paged_kernel, lambda: _pool_kernel_supported(cache))
     toks, kp, vp, new_len = _paged_decode_impl(
         params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
         jnp.asarray(lengths, jnp.int32),
@@ -483,9 +566,156 @@ def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
         temperature=config.temperature, top_k=config.top_k,
         policy_key=policy_key,
         rope=meta.get("pos_encoding", "learned") == "rope",
-        num_kv_heads=meta.get("num_kv_heads"))
+        num_kv_heads=meta.get("num_kv_heads"), paged_kernel=kernel)
     cache.kp, cache.vp = kp, vp
     return toks, new_len
+
+
+def _compile_decode_step(model, cache: PagedKVCache, table, lengths,
+                         last_tokens, *, paged_kernel=None, params=None):
+    """Lower + AOT-compile ONE single-token decode step (no execution);
+    returns ``(compiled, resolved_kernel)`` and records the executable
+    into the process compile-watch table as
+    ``paged_decode_step[<kernel>]`` — the routing that lets its
+    cost/memory analysis prove what the step materializes."""
+    params = model.params if params is None else params
+    meta = model.lm_meta
+    kernel = _resolve_paged_kernel(
+        paged_kernel, lambda: _pool_kernel_supported(cache))
+    policy_key = (str(activation_dtype()), str(compute_dtype()))
+    compiled = _paged_decode_impl.lower(
+        params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
+        jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(last_tokens, jnp.int32), jax.random.PRNGKey(0),
+        num_layers=meta["num_layers"], num_heads=meta["num_heads"],
+        n_new=1, page_size=cache.page_size, temperature=0.0, top_k=None,
+        policy_key=policy_key,
+        rope=meta.get("pos_encoding", "learned") == "rope",
+        num_kv_heads=meta.get("num_kv_heads"),
+        paged_kernel=kernel).compile()
+    _compile_watch.record_executable(f"paged_decode_step[{kernel}]",
+                                     compiled)
+    return compiled, kernel
+
+
+def paged_decode_step_stats(model, cache: PagedKVCache, table, lengths,
+                            last_tokens, *, paged_kernel=None,
+                            params=None):
+    """:func:`compile_watch.executable_stats` of ONE compiled
+    single-token decode step — FLOPs, bytes accessed, and the memory
+    analysis (arg/output/temp/peak-HBM bytes). At
+    ``paged_kernel="dense"`` the table includes the per-layer
+    (B, P*S, KV, D) ``_paged_view`` materialization; with the Pallas
+    kernel that temp is gone."""
+    compiled, _ = _compile_decode_step(model, cache, table, lengths,
+                                       last_tokens,
+                                       paged_kernel=paged_kernel,
+                                       params=params)
+    return _compile_watch.executable_stats(compiled)
+
+
+_HLO_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                    "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                    "pred": 1}
+
+
+def _hlo_gather_bytes(hlo_text: str, min_bytes: int) -> tuple[int, int]:
+    """(count, total output bytes) of gather ops at/above ``min_bytes``
+    in an HLO module — the dense-view materializations. The same
+    text-level accounting idiom as ``collective_bench.collective_bytes``
+    (wire probe): static, backend-independent, no execution."""
+    import re
+    count, total = 0, 0
+    for line in hlo_text.splitlines():
+        m = re.search(r"=\s*(\w+)\[([\d,]*)\][^=]*?\bgather\(",
+                      line.strip())
+        if not m:
+            continue
+        dt = _HLO_DTYPE_BYTES.get(m.group(1))
+        if dt is None or not m.group(2):
+            continue
+        n = dt
+        for d in m.group(2).split(","):
+            n *= int(d)
+        if n >= min_bytes:
+            count += 1
+            total += n
+    return count, total
+
+
+def decode_hbm_probe(*, b: int = 8, pages_per_seq: int = 16,
+                     page_size: int = 16, d_model: int = 256,
+                     num_heads: int = 4, num_kv_heads: int = 1,
+                     num_layers: int = 2, vocab: int = 512) -> dict:
+    """Static per-decode-step HBM accounting, dense view vs paged
+    kernel (the tentpole's measured receipt, ISSUE 9). Lowers ONE
+    single-token decode step both ways — no execution, so it runs on
+    any backend — and reports:
+
+    - ``materialized_gather_{ops,bytes}``: gather instructions at/above
+      the (B, P*S, KV, D) view size in each compiled HLO. The dense
+      path carries exactly ``2 * num_layers`` of them (k and v view per
+      layer); the kernel path carries ZERO — the materialization is
+      gone, statically provable.
+    - ``attn_hbm_bytes``: the static attention-traffic model per step —
+      dense = 3x the view per consumption (pool gather read + view
+      write + attention re-read); paged = each row's LIVE pages read
+      once (rows skip their unallocated/out-of-length tail).
+    - ``executable``: cost/memory analysis of both compiled steps
+      (``compile_watch.executable_stats``). Off-TPU the paged step
+      compiles in interpreter mode, so its executable numbers describe
+      the emulation, not the kernel — the static rows above are the
+      backend-independent receipt.
+    """
+    import jax as _jax
+
+    from bigdl_tpu.models import TransformerLM
+    model = TransformerLM(vocab, d_model=d_model, num_heads=num_heads,
+                          num_layers=num_layers,
+                          max_len=2 * pages_per_seq * page_size,
+                          with_log_softmax=False,
+                          num_kv_heads=num_kv_heads)
+    model.materialize(_jax.random.PRNGKey(0))
+    model.evaluate()
+    kv = num_kv_heads or num_heads
+    head_dim = d_model // num_heads
+    cache = PagedKVCache(num_layers, num_pages=b * pages_per_seq + 1,
+                         page_size=page_size, kv_heads=kv,
+                         head_dim=head_dim)
+    table = np.arange(b * pages_per_seq, dtype=np.int32).reshape(
+        b, pages_per_seq)
+    rs = np.random.default_rng(0)
+    cap = pages_per_seq * page_size
+    lengths = rs.integers(1, cap - 2, size=(b,)).astype(np.int32)
+    last = np.ones((b,), np.int32)
+    itemsize = jnp.dtype(cache.kp[0].dtype).itemsize
+    view_bytes = b * pages_per_seq * page_size * kv * head_dim * itemsize
+    consumptions = 2 * num_layers                      # k and v, per layer
+    live_pages = int(np.sum(-(-(lengths + 1) // page_size)))
+    paged_bytes = live_pages * page_size * kv * head_dim * itemsize \
+        * consumptions
+    dense_bytes = 3 * view_bytes * consumptions
+    out = {"geometry": f"B{b} P{pages_per_seq} S{page_size} d{d_model} "
+                       f"L{num_layers} kv{kv} hd{head_dim}",
+           "view_shape": [b, pages_per_seq * page_size, kv, head_dim],
+           "view_bytes": int(view_bytes),
+           "attn_hbm_bytes": {"dense": int(dense_bytes),
+                              "paged": int(paged_bytes)},
+           "reduction": dense_bytes / max(paged_bytes, 1),
+           "peak_view_bytes_per_layer": int(2 * view_bytes),
+           "executable": {}, "materialized_gathers": {}}
+    kernels = {"dense": "dense",
+               "paged": "pallas" if _pool_kernel_supported(cache)
+               else "interpret"}
+    for label, kernel in kernels.items():
+        compiled, _ = _compile_decode_step(model, cache, table, lengths,
+                                           last, paged_kernel=kernel)
+        ops, byts = _hlo_gather_bytes(compiled.as_text(), view_bytes)
+        out["materialized_gathers"][label] = {"ops": ops, "bytes": byts}
+        out["executable"][label] = _compile_watch.executable_stats(
+            compiled)
+    out["paged_compiled_as"] = kernels["paged"]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -495,11 +725,11 @@ def paged_decode(model, cache: PagedKVCache, table, lengths, last_tokens,
 @functools.partial(jax.jit, static_argnames=(
     "t_layers", "t_heads", "t_kv", "t_rope", "d_layers", "d_heads",
     "d_kv", "d_rope", "max_len", "n_new", "gamma", "temperature",
-    "policy_key"))
+    "policy_key", "paged_kernel"))
 def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
                       t_layers, t_heads, t_kv, t_rope, d_layers, d_heads,
                       d_kv, d_rope, max_len, n_new, gamma,
-                      temperature, policy_key):
+                      temperature, policy_key, paged_kernel="dense"):
     """Speculative loop. Per outer round: draft proposes gamma tokens
     one-by-one, target verifies all gamma+1 positions in ONE T=gamma+1
     cache step, rows accept a prefix plus one correction/bonus token.
@@ -551,7 +781,7 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
         for li in range(d_layers):
             x, nck[li], ncv[li] = _ragged_block_step(
                 blocks_d[li], x, dck[li], dcv[li], p + 1, d_heads,
-                max_len, d_rope, d_kv)
+                max_len, d_rope, d_kv, paged_kernel)
         lg = _row_logits(d_params, d_layers, x,
                          jnp.zeros_like(p)).astype(jnp.float32)
         if temperature == 0.0:
@@ -602,7 +832,7 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
         for li in range(t_layers):
             x, ntck[li], ntcv[li] = _ragged_block_step(
                 blocks_t[li], x, tck[li], tcv[li], cols_last, t_heads,
-                max_len, t_rope, t_kv)
+                max_len, t_rope, t_kv, paged_kernel)
         _, _, norm_p, head_p = _model_parts(t_params, t_layers)
         tg = _linear(head_p, _ln(norm_p, x)).astype(jnp.float32)
         if temperature == 0.0:
@@ -684,7 +914,8 @@ def _speculative_impl(t_params, d_params, prompt, lengths, rng, *,
 def speculative_generate(model, draft_model, prompts, *,
                          max_new_tokens: int = 32, gamma: int = 4,
                          temperature: float = 0.0, rng=None,
-                         params=None, draft_params=None):
+                         params=None, draft_params=None,
+                         paged_kernel=None):
     """Speculative decoding with ~1 target forward per ``accepted+1``
     tokens instead of per token.
 
@@ -702,7 +933,15 @@ def speculative_generate(model, draft_model, prompts, *,
     only for rows still short of their token budget at each round's
     start (rows that finished early keep riding the lockstep loop but
     their masked proposals no longer deflate the rate — ADVICE.md,
-    mixed-progress batches)."""
+    mixed-progress batches).
+
+    ``paged_kernel``: the same decode-kernel switch as
+    :func:`paged_decode` — the draft's per-token steps and the
+    target's T=gamma+1 verify step attend through the Pallas
+    page-walk kernel (dense caches viewed as contiguous pages) instead
+    of the masked full-cache einsum, so the speculative path does not
+    silently keep paying the dense gather. "auto"/None engages it on
+    TPU when BOTH models' geometries are legal."""
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     if temperature < 0:
@@ -721,6 +960,17 @@ def speculative_generate(model, draft_model, prompts, *,
     policy_key = (str(activation_dtype()), str(compute_dtype()))
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    max_len_eff = min(t_meta["max_len"], d_meta["max_len"])
+
+    def _both_supported():
+        from bigdl_tpu.ops.pallas.paged_attention import \
+            dense_cache_supported
+        dims = (t_params["0"]["tok"].shape[1] // t_meta["num_heads"],
+                d_params["0"]["tok"].shape[1] // d_meta["num_heads"])
+        return all(dense_cache_supported(hd, max_len_eff)
+                   for hd in dims)
+
+    kernel = _resolve_paged_kernel(paged_kernel, _both_supported)
     out, acc, proposed, rounds = _speculative_impl(
         t_params, d_params, jnp.asarray(batch), jnp.asarray(lengths),
         rng,
@@ -730,9 +980,10 @@ def speculative_generate(model, draft_model, prompts, *,
         d_layers=d_meta["num_layers"], d_heads=d_meta["num_heads"],
         d_kv=d_meta.get("num_kv_heads"),
         d_rope=d_meta.get("pos_encoding", "learned") == "rope",
-        max_len=min(t_meta["max_len"], d_meta["max_len"]),
+        max_len=max_len_eff,
         n_new=max_new_tokens, gamma=gamma,
-        temperature=float(temperature), policy_key=policy_key)
+        temperature=float(temperature), policy_key=policy_key,
+        paged_kernel=kernel)
     rounds_i = max(int(rounds), 1)
     proposed_i = int(proposed)
     stats = {"acceptance_rate": float(int(acc)) / max(proposed_i, 1),
@@ -849,7 +1100,7 @@ class ContinuousBatcher:
                  max_burst: int = 8, eos_id: int | None = None,
                  registry=None, summary=None, health=None,
                  watch=None, health_name: str = "serving_batcher",
-                 on_complete=None, on_prefill=None):
+                 on_complete=None, on_prefill=None, paged_kernel=None):
         meta = model.lm_meta
         self.model = model
         self.max_batch = max_batch
@@ -857,6 +1108,13 @@ class ContinuousBatcher:
         self.max_burst = max_burst
         self.eos_id = eos_id
         self.page_size = page_size
+        # decode-kernel switch, forwarded to every prefill/decode call;
+        # None keeps the callee's own "auto" resolution AND keeps the
+        # kwarg off the wire (tests monkeypatch paged_prefill/
+        # paged_decode with fakes that predate it)
+        self.paged_kernel = paged_kernel
+        self._kernel_kw = ({} if paged_kernel is None
+                           else {"paged_kernel": paged_kernel})
         kv = meta.get("num_kv_heads") or meta["num_heads"]
         head_dim = model.params["0"]["tok"].shape[1] // meta["num_heads"]
         self.cache = PagedKVCache(meta["num_layers"], num_pages,
@@ -1091,7 +1349,8 @@ class ContinuousBatcher:
                 # the per-request value
                 first, _ = self._prefill_fn(
                     self.model, self.cache, row[None, :], padded,
-                    lengths=np.asarray([len(prompt)], np.int32))
+                    lengths=np.asarray([len(prompt)], np.int32),
+                    **self._kernel_kw)
                 # deliberate sync: TTFT is DEFINED by this readback
                 tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
             # TTFT = queue wait + prefill, closed by the readback above
@@ -1239,7 +1498,8 @@ class ContinuousBatcher:
                             host_sync="first-token readback"):
                 first, _ = self._prefill_fn(
                     self.model, self.cache, row[None, :], padded,
-                    lengths=np.asarray([len(prompt)], np.int32))
+                    lengths=np.asarray([len(prompt)], np.int32),
+                    **self._kernel_kw)
                 # deliberate sync: the first token rides the snapshot
                 tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
             kv = self._export_kv(pages, len(prompt))
@@ -1311,7 +1571,8 @@ class ContinuousBatcher:
                         host_sync="token readback"):
             toks, new_len = self._decode_fn(self.model, self.cache,
                                             self.table, self.lengths,
-                                            self.last, n_new=burst)
+                                            self.last, n_new=burst,
+                                            **self._kernel_kw)
             toks = np.asarray(toks)
         dt = time.monotonic() - t0
         self._m_tok_lat.observe(dt / burst)

@@ -1,5 +1,7 @@
 """Pallas TPU kernels, each justified by a measured profile (docs/PERF.md):
-``lrn`` (Inception's top HBM consumer) and ``flash_attention``
-(long-context: O(S*D) memory vs the XLA path's (B,H,S,S) score matrix).
+``lrn`` (Inception's top HBM consumer), ``flash_attention``
+(long-context: O(S*D) memory vs the XLA path's (B,H,S,S) score matrix),
+``fused_ce`` (the LM head), and ``paged_attention`` (serving decode
+straight off the KV page pool — no dense cache-view gather).
 Import the submodules — their names are not re-exported here so that
 ``from bigdl_tpu.ops.pallas import lrn`` keeps meaning the module."""

@@ -38,7 +38,8 @@ __all__ = ["tune", "TuneResult", "Measurement", "VMEM_BUDGET_BYTES",
            "flash_candidates", "flash_est_vmem", "fused_ce_candidates",
            "fused_ce_est_vmem", "lrn_candidates", "lrn_est_vmem",
            "maxpool_candidates", "bucket_mb_candidates",
-           "batch_geometry_candidates", "tile_divisors"]
+           "batch_geometry_candidates", "tile_divisors",
+           "paged_attention_candidates", "paged_attention_est_vmem"]
 
 logger = logging.getLogger("bigdl_tpu.tuning")
 
@@ -269,6 +270,31 @@ def maxpool_candidates(h: int, n: int) -> list[dict]:
     hts = [ht for ht in (8, 4, 2) if h % ht == 0] or [h]
     nts = [nt for nt in (256, 128) if n % nt == 0] or [min(n, 256)]
     return [{"h_t": ht, "n_t": nt} for ht in hts for nt in nts]
+
+
+def paged_attention_candidates(t: int, g: int, *, bt_cap: int = 8,
+                               gp_octaves: int = 2) -> list[dict]:
+    """(bt, gp) grid for the paged-attention decode kernel at query
+    width ``t`` and group size ``g`` (query heads per kv head): every
+    divisor of ``t`` up to ``bt_cap`` crossed with sublane-aligned
+    group paddings — more padded rows fatten the score tile (MXU
+    utilization at tiny G) at the cost of wasted lanes."""
+    bts = [b for b in range(min(bt_cap, t), 0, -1) if t % b == 0]
+    gp0 = -(-g // 8) * 8
+    gps = [gp0 * (1 << k) for k in range(max(gp_octaves, 1))]
+    return [{"bt": bt, "gp": gp} for bt in bts for gp in gps]
+
+
+def paged_attention_est_vmem(s: int, d: int, dtype_bytes: int = 2):
+    """Kernel footprint at page size ``s``, head dim ``d``: f32 score +
+    prob tiles (R, S), f32 acc (R, D) + m/l columns, double-buffered
+    k/v page blocks and the q block (R = bt * gp rows)."""
+    def est(c: dict) -> int:
+        r = c["bt"] * c["gp"]
+        f32 = 4
+        return (2 * r * s * f32 + r * (d + 2) * f32
+                + 2 * (2 * s * d + r * d) * dtype_bytes)
+    return est
 
 
 def bucket_mb_candidates() -> list[dict]:

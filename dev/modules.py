@@ -10,7 +10,8 @@ MODULES = {
            "tests/test_criterions.py", "tests/test_recurrent.py",
            "tests/test_gradient_check.py", "tests/test_remat.py",
            "tests/test_module_times.py"],
-    "kernels": ["tests/test_fused_ce.py", "tests/test_maxpool_kernel.py"],
+    "kernels": ["tests/test_fused_ce.py", "tests/test_maxpool_kernel.py",
+                "tests/test_paged_attention.py"],
     "tensor": ["tests/test_ref_oracle.py", "tests/test_golden_fixtures.py"],
     "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
                 "tests/test_native_loader.py", "tests/test_prefetch.py"],

@@ -141,6 +141,12 @@ def main():
                                f"{max(1, 8 // nproc)}")
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # the XLA CPU client refuses multi-process computations unless a
+    # cross-process collectives implementation is configured — without
+    # this every worker dies in its first sharded device_put with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend" (the whole pre-existing tier-1 multihost failure set)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=f"localhost:{port}",
                                num_processes=nproc, process_id=pid)
     sys.path.insert(0, os.path.dirname(os.path.dirname(

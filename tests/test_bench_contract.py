@@ -486,6 +486,219 @@ class TestCompileColdStartRow:
         assert row["cold_first_step_s"] > row["warm_first_step_s"]
 
 
+class TestBenchGate:
+    """ISSUE 9 satellite (ROADMAP item 5): ``--gate BASELINE.json``
+    compares selected rows against a recorded baseline with per-row
+    thresholds, exits non-zero (4) on a real slowdown, and
+    ``--baseline-out`` records the run as the next baseline."""
+
+    ROW = {"metric": "transformer_lm_train_tokens_per_sec_per_chip",
+           "value": 100.0, "unit": "tokens/sec/chip"}
+
+    def _arm(self, monkeypatch, value=100.0):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        row = dict(self.ROW, value=value)
+        monkeypatch.setattr(bench, "bench_transformer_lm",
+                            lambda: dict(row))
+
+    def _baseline(self, tmp_path, value=100.0, **spec):
+        path = tmp_path / "BASELINE.json"
+        entry = {"value": value, **spec}
+        path.write_text(json.dumps(
+            {"version": 1, "rows": {self.ROW["metric"]: entry}}))
+        return str(path)
+
+    def test_gate_passes_recorded_baseline(self, monkeypatch, capsys,
+                                           tmp_path):
+        self._arm(monkeypatch)
+        path = self._baseline(tmp_path)
+        bench.main(["--rows", "transformer", "--gate", path])  # no exit
+        lines = _parse_lines(capsys.readouterr().out)
+        gate = next(line for line in lines
+                    if line.get("metric") == "bench_gate")
+        assert gate["value"] == 1.0 and gate["failures"] == []
+        assert gate["checked"] == [self.ROW["metric"]]
+        # the gate verdict also rides the aggregate (last line)
+        assert any(r["metric"] == "bench_gate"
+                   for r in lines[-1]["rows"])
+
+    def test_gate_fails_injected_slowdown(self, monkeypatch, capsys,
+                                          tmp_path):
+        self._arm(monkeypatch, value=50.0)       # 2x slowdown
+        path = self._baseline(tmp_path)
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "transformer", "--gate", path])
+        assert ei.value.code == 4
+        gate = next(line for line in
+                    _parse_lines(capsys.readouterr().out)
+                    if line.get("metric") == "bench_gate")
+        assert gate["value"] == 0.0
+        assert gate["failures"][0]["metric"] == self.ROW["metric"]
+        assert "min_ratio" in gate["failures"][0]["reason"]
+
+    def test_gate_threshold_tolerates_noise(self, monkeypatch, tmp_path):
+        """A value inside the per-row min_ratio band passes; tightening
+        the ratio in the baseline file flips it."""
+        self._arm(monkeypatch, value=90.0)
+        bench.main(["--rows", "transformer", "--gate",
+                    self._baseline(tmp_path)])   # default 0.8 passes
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "transformer", "--gate",
+                        self._baseline(tmp_path, min_ratio=0.95)])
+        assert ei.value.code == 4
+
+    def test_gate_lower_is_better_direction(self, monkeypatch, capsys,
+                                            tmp_path):
+        """serving_ttft-style rows gate in the other direction: a
+        LARGER value is the regression."""
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        row = {"metric": "serving_ttft", "value": 0.30,
+               "unit": "seconds"}
+        monkeypatch.setattr(bench, "bench_serving_ttft",
+                            lambda **kw: dict(row))
+        path = tmp_path / "b.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            "serving_ttft": {"value": 0.10}}}))
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "serving_ttft", "--gate", str(path)])
+        assert ei.value.code == 4
+        row["value"] = 0.11                      # inside 0.1/0.8
+        bench.main(["--rows", "serving_ttft", "--gate", str(path)])
+
+    def test_gate_fails_on_errored_baselined_row(self, monkeypatch,
+                                                 capsys, tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+
+        def boom():
+            raise RuntimeError("no tokens today")
+        monkeypatch.setattr(bench, "bench_transformer_lm", boom)
+        path = self._baseline(tmp_path)
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "transformer", "--gate", path])
+        assert ei.value.code == 4
+        gate = next(line for line in
+                    _parse_lines(capsys.readouterr().out)
+                    if line.get("metric") == "bench_gate")
+        assert "row errored" in gate["failures"][0]["reason"]
+
+    def test_gate_skips_unrequested_rows_loudly(self, monkeypatch,
+                                                capsys, tmp_path):
+        """Baseline rows this invocation did not run are reported as
+        skipped, not judged and not silently dropped."""
+        self._arm(monkeypatch)
+        path = tmp_path / "b.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            self.ROW["metric"]: {"value": 100.0},
+            "serving_tokens_per_sec": {"value": 512.0}}}))
+        bench.main(["--rows", "transformer", "--gate", str(path)])
+        gate = next(line for line in
+                    _parse_lines(capsys.readouterr().out)
+                    if line.get("metric") == "bench_gate")
+        assert gate["skipped"] == ["serving_tokens_per_sec"]
+        assert gate["value"] == 1.0
+
+    def test_unreadable_baseline_fails_gate(self, monkeypatch, tmp_path):
+        self._arm(monkeypatch)
+        path = tmp_path / "b.json"
+        path.write_text("{not json")
+        with pytest.raises(SystemExit) as ei:
+            bench.main(["--rows", "transformer", "--gate", str(path)])
+        assert ei.value.code == 4
+
+    def test_baseline_out_round_trip(self, monkeypatch, capsys,
+                                     tmp_path):
+        """--baseline-out records the run; gating the same run against
+        it passes (the update-the-baseline workflow)."""
+        self._arm(monkeypatch)
+        out = tmp_path / "new_baseline.json"
+        metrics = tmp_path / "metrics.txt"
+        bench.main(["--rows", "transformer", "--baseline-out", str(out),
+                    "--metrics-out", str(metrics)])
+        doc = json.loads(out.read_text())
+        entry = doc["rows"][self.ROW["metric"]]
+        assert entry["value"] == 100.0
+        assert entry["min_ratio"] == bench.GATE_DEFAULT_MIN_RATIO
+        assert entry["direction"] == "higher"
+        assert metrics.exists()                 # emitted alongside
+        capsys.readouterr()
+        bench.main(["--rows", "transformer", "--gate", str(out)])
+        gate = next(line for line in
+                    _parse_lines(capsys.readouterr().out)
+                    if line.get("metric") == "bench_gate")
+        assert gate["value"] == 1.0
+
+    def test_baseline_out_skips_error_rows(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+
+        def boom():
+            raise RuntimeError("nope")
+        monkeypatch.setattr(bench, "bench_transformer_lm", boom)
+        monkeypatch.setattr(bench, "bench_decode",
+                            lambda: {"metric": "decode_row",
+                                     "value": 5.0, "unit": "t/s"})
+        out = tmp_path / "b.json"
+        bench.main(["--rows", "transformer,decode",
+                    "--baseline-out", str(out)])
+        doc = json.loads(out.read_text())
+        assert list(doc["rows"]) == ["decode_row"]
+
+
+class TestServingDecodeHBMRow:
+    """ISSUE 9 satellite: serving_decode_hbm_bytes — static accounting
+    of the decode step's HBM traffic, dense view vs paged kernel (the
+    tentpole's measured receipt) — rides the standard
+    row/known/all contract."""
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        fake = {"metric": "serving_decode_hbm_bytes", "value": 4.5,
+                "unit": "x (dense-view / paged attention HBM bytes "
+                        "per decode step)",
+                "materialized_gather_ops_dense": 4,
+                "materialized_gather_ops_paged": 0}
+        monkeypatch.setattr(bench, "bench_serving_decode_hbm",
+                            lambda: dict(fake))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "serving_decode_hbm_bytes",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "serving_decode_hbm_bytes"
+        assert lines[-1]["rows"][0]["value"] == 4.5
+        with open(out) as f:
+            assert "bench_serving_decode_hbm_bytes 4.5" in f.read()
+
+    def test_row_in_all(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "serving_decode_hbm_bytes" in [r["metric"]
+                                              for r in agg["rows"]]
+
+    def test_real_subprocess_probe(self):
+        """The REAL CPU-subprocess probe (tiny geometry): the dense
+        step carries the view-sized gather materializations, the paged
+        step carries none, and the static traffic model reports a
+        reduction."""
+        row = bench.bench_serving_decode_hbm(
+            b=3, pages_per_seq=8, page_size=4, d_model=64,
+            num_heads=4, num_kv_heads=2, num_layers=2, vocab=128)
+        assert row["metric"] == "serving_decode_hbm_bytes"
+        assert row["value"] > 1.0
+        assert row["materialized_gather_ops_dense"] > 0
+        assert row["materialized_gather_ops_paged"] == 0
+        assert row["materialized_gather_bytes_paged"] == 0
+        assert row["attn_hbm_bytes_paged"] < row["attn_hbm_bytes_dense"]
+        assert row["bytes_accessed_dense_exec"] > 0
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:
