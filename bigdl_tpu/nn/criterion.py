@@ -522,11 +522,20 @@ class MaskedCriterion(Criterion):
         self.criterion = criterion
 
     def apply(self, x, target, mask):
+        total, count = self.masked_sum(x, target, mask)
+        if getattr(self.criterion, "size_average", True):
+            return total / jnp.maximum(count, 1.0)
+        return total
+
+    def masked_sum(self, x, target, mask):
+        """Unnormalized ``(masked loss sum, valid-row count)`` — the
+        accumulation seam (optim/accumulation.py): gradient accumulation
+        sums numerator and denominator across microbatches separately
+        and divides ONCE, so a short batch split into microbatches with
+        uneven valid counts still reproduces the full batch's masked
+        mean exactly."""
         per_row = jax.vmap(
             lambda xi, ti: self.criterion.apply(xi[None], ti[None]))(
                 x, target)
         m = mask.astype(per_row.dtype)
-        total = jnp.sum(per_row * m)
-        if getattr(self.criterion, "size_average", True):
-            return total / jnp.maximum(jnp.sum(m), 1.0)
-        return total
+        return jnp.sum(per_row * m), jnp.sum(m)

@@ -13,6 +13,7 @@ from bigdl_tpu.optim.validation import (ValidationMethod, ValidationResult,
                                         AccuracyResult, LossResult,
                                         Top1Accuracy, Top5Accuracy, Loss)
 from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.remat import known_remat_policies
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.validator import (Validator, LocalValidator,
                                        DistriValidator)

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.observability import trace
-from bigdl_tpu.optim.optimizer import Optimizer, _clip_gradients
+from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.parallel.engine import (get_mesh, data_sharding, replicated)
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -279,68 +279,61 @@ class DistriOptimizer(Optimizer):
             opt_state = jax.device_put(opt_state, opt_shard)
 
         use_mask = self._pad_stage is not None
+        masked = None
         if use_mask:
             from bigdl_tpu.nn.criterion import MaskedCriterion
             masked = MaskedCriterion(criterion)
 
+        # memory-for-throughput knobs applied at step construction:
+        # named remat policy around the forward, microbatched gradient
+        # accumulation around fwd/bwd (optim/remat.py,
+        # optim/accumulation.py); "none" + k=1 is EXACTLY the plain step
+        from bigdl_tpu.optim.remat import remat_forward
+        fwd = remat_forward(model, self.remat_policy)
+
         if su is not None and su.codec is not None:
             # explicit construction: the whole step runs per-shard under
-            # shard_map — local forward/backward, bucketed compressed
-            # reduce-scatter (+ error feedback), sharded update on f32
-            # masters, compressed param all-gather
+            # shard_map — local forward/backward (scanned k microbatches
+            # at a time under grad accumulation, with the bucketed
+            # compressed reduce-scatter + error feedback firing ONCE on
+            # the accumulated grads), sharded update on f32 masters,
+            # compressed param all-gather
             def local_vag(p, mstate_in, data, labels, key):
                 if self.input_transform is not None:
                     data = self.input_transform(data)
 
                 def loss_fn(pp):
-                    y, new_mstate = model.apply(pp, mstate_in, data,
-                                                training=True, rng=key)
+                    y, new_mstate = fwd(pp, mstate_in, data,
+                                        training=True, rng=key)
                     return criterion.apply(y, labels), new_mstate
 
                 return jax.value_and_grad(loss_fn, has_aux=True)(p)
 
             explicit_step = su.make_explicit_step(
-                local_vag, grad_clip=self.grad_clip)
+                local_vag, grad_clip=self.grad_clip,
+                num_microbatches=self.grad_accumulation)
 
             def train_step(params, mstate, opt_state, rng, data, labels,
                            epoch, n_valid=None):
                 return explicit_step(params, mstate, opt_state, rng,
                                      data, labels, epoch)
         else:
-            def train_step(params, mstate, opt_state, rng, data, labels,
-                           epoch, n_valid=None):
-                if self.input_transform is not None:
-                    data = self.input_transform(data)
-
-                def loss_fn(p):
-                    y, new_mstate = model.apply(p, mstate, data,
-                                                training=True, rng=rng)
-                    if use_mask:
-                        # validity mask from the real row count: padded
-                        # rows contribute exactly zero to loss and the
-                        # gradient allreduce (nn.MaskedCriterion); XLA
-                        # shards the iota like the batch
-                        mask = jnp.arange(data.shape[0]) < n_valid
-                        return masked.apply(y, labels, mask), new_mstate
-                    # mean over the GLOBAL batch — the gradient allreduce
-                    # this induces in backward IS the reference's whole
-                    # parameters/AllReduceParameter machinery
-                    return criterion.apply(y, labels), new_mstate
-
-                (loss, new_mstate), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-                grads = _clip_gradients(grads, self.grad_clip)
-                opt_state = dict(opt_state, epoch=epoch)
-                if su is not None:
-                    # implicit construction: same loss/grads as the
-                    # replicated path (bit-identical), update math and
-                    # optimizer state sharded 1/N under shard_map
-                    new_params, new_opt_state = su.apply_update(
-                        grads, params, opt_state)
-                else:
-                    new_params, new_opt_state = optim.update(
-                        grads, params, opt_state)
-                return new_params, new_mstate, new_opt_state, loss
+            # global-view construction: mean over the GLOBAL batch — the
+            # gradient allreduce this induces in backward IS the
+            # reference's whole parameters/AllReduceParameter machinery;
+            # under the implicit sharded update the update math and
+            # optimizer state run 1/N per replica (su.apply_update), and
+            # with grad accumulation the induced reduction fires once
+            # per ACCUMULATED step (k x fewer collective bytes per
+            # example)
+            from bigdl_tpu.optim.accumulation import make_train_step
+            train_step = make_train_step(
+                fwd=fwd, criterion=criterion, masked=masked,
+                input_transform=self.input_transform,
+                grad_clip=self.grad_clip,
+                update_fn=(su.apply_update if su is not None
+                           else optim.update),
+                num_microbatches=self.grad_accumulation)
 
         # label_shard is None under sequence_parallel (rank-derived at
         # placement, _shard_batch); jit then inherits the arg sharding

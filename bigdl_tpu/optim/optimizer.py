@@ -104,8 +104,11 @@ class Optimizer:
             return super().__new__(LocalOptimizer)
         return super().__new__(cls)
 
-    def __init__(self, model, dataset, criterion, batch_size=None, **kw):
+    def __init__(self, model, dataset, criterion, batch_size=None, *,
+                 remat_policy: str | None = None,
+                 grad_accumulation: int = 1, **kw):
         from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.optim.remat import check_remat_policy
         self.model = model
         if batch_size is not None:
             # RDD[Sample]+batchSize overload (reference :150-162)
@@ -128,6 +131,15 @@ class Optimizer:
         self._profiling = False
         self.grad_clip = None
         self.input_transform = None
+        # memory-for-throughput knobs (optim/remat.py,
+        # optim/accumulation.py, docs/PERFORMANCE.md): a named
+        # jax.checkpoint policy applied to the model forward at step
+        # construction, and the number of microbatches one compiled
+        # step scans with the gradient accumulated before the single
+        # optimizer update. Both are AOT-cache key material.
+        self.remat_policy = check_remat_policy(remat_policy)
+        self.grad_accumulation = self._check_grad_accumulation(
+            grad_accumulation)
         self.train_summary = None
         self.val_summary = None
         # async dispatch: how many steps may be in flight before the loop
@@ -282,6 +294,45 @@ class Optimizer:
         self.end_when = end_when
         return self
 
+    @staticmethod
+    def _check_grad_accumulation(k) -> int:
+        if int(k) < 1:
+            raise ValueError(f"num_microbatches must be >= 1, got {k}")
+        return int(k)
+
+    def set_remat_policy(self, policy: str | None):
+        """Select the activation-rematerialization policy applied to
+        the model forward when the train step is constructed
+        (optim/remat.py, docs/PERFORMANCE.md): ``"none"`` (default,
+        save every residual), ``"dots_saveable"`` (save matmul/conv
+        outputs), ``"per_block"`` (checkpoint each top-level block of a
+        Sequential stack — the selective policy for transformer/
+        inception stacks), ``"nothing_saveable"`` (save only region
+        inputs; maximum HBM savings, one forward of recompute). Loss
+        and gradients are BIT-identical across policies — only peak
+        activation memory and recompute move. The policy keys the AOT
+        executable cache, so switching it misses correctly. Returns
+        self."""
+        from bigdl_tpu.optim.remat import check_remat_policy
+        self.remat_policy = check_remat_policy(policy)
+        return self
+
+    def set_grad_accumulation(self, num_microbatches: int = 1):
+        """Compile the train step to ``lax.scan`` ``num_microbatches``
+        microbatches through forward/backward with gradients
+        accumulated on device, then run the optimizer update (and, on
+        the sharded-update path, the bucketed gradient reduce-scatter)
+        EXACTLY ONCE per step (optim/accumulation.py,
+        docs/PERFORMANCE.md). The loop still feeds full batches; the
+        split is internal and strided, so an effectively k×-larger
+        batch runs at near-constant peak activation HBM.
+        ``num_microbatches=1`` IS the plain step — same construction,
+        same AOT cache key. The batch must divide by k (refused loudly
+        at step construction otherwise). Returns self."""
+        self.grad_accumulation = self._check_grad_accumulation(
+            num_microbatches)
+        return self
+
     def set_sharded_update(self, enabled: bool = True, *,
                           wire_codec=None, bucket_mb: float | None = None):
         """Configure the fully cross-replica-sharded weight update
@@ -365,7 +416,12 @@ class Optimizer:
                 self.bucket_mb,
                 getattr(self, "tensor_parallel", None),
                 getattr(self, "sequence_parallel", None),
-                getattr(self, "shard_optim_state", None))
+                getattr(self, "shard_optim_state", None),
+                # remat + accumulation change the compiled program at
+                # identical shapes — they must miss the cache; k=1 and
+                # policy "none" ARE the plain step (same key as a run
+                # that never configured them)
+                self.remat_policy, self.grad_accumulation)
 
     def set_metrics_server(self, port: int = 0, host: str = "127.0.0.1",
                            *, liveness_deadline: float = 600.0):
@@ -846,33 +902,24 @@ class LocalOptimizer(Optimizer):
             self._resume(optim, params)
 
         use_mask = self._pad_stage is not None
+        masked = None
         if use_mask:
             from bigdl_tpu.nn.criterion import MaskedCriterion
             masked = MaskedCriterion(criterion)
 
-        def train_step(params, mstate, opt_state, rng, data, labels, epoch,
-                       n_valid=None):
-            if self.input_transform is not None:
-                data = self.input_transform(data)
-
-            def loss_fn(p):
-                y, new_mstate = model.apply(p, mstate, data, training=True,
-                                            rng=rng)
-                if use_mask:
-                    # validity mask materialized in-step from the real
-                    # row count: padded rows contribute exactly zero to
-                    # loss and gradient (nn.MaskedCriterion)
-                    mask = jnp.arange(data.shape[0]) < n_valid
-                    return masked.apply(y, labels, mask), new_mstate
-                return criterion.apply(y, labels), new_mstate
-
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            grads = _clip_gradients(grads, self.grad_clip)
-            opt_state = dict(opt_state, epoch=epoch)
-            new_params, new_opt_state = optim.update(grads, params,
-                                                     opt_state)
-            return new_params, new_mstate, new_opt_state, loss
+        # the step program is assembled from the memory knobs: the
+        # (possibly remat-wrapped) forward and the microbatched
+        # gradient-accumulation scan (optim/remat.py,
+        # optim/accumulation.py); policy "none" + k=1 is EXACTLY the
+        # plain step
+        from bigdl_tpu.optim.accumulation import make_train_step
+        from bigdl_tpu.optim.remat import remat_forward
+        train_step = make_train_step(
+            fwd=remat_forward(model, self.remat_policy),
+            criterion=criterion, masked=masked,
+            input_transform=self.input_transform,
+            grad_clip=self.grad_clip, update_fn=optim.update,
+            num_microbatches=self.grad_accumulation)
 
         # explicit lower -> compile -> cache step construction
         # (tuning/aot_cache.py): executables are built per batch

@@ -299,24 +299,72 @@ class ShardedWeightUpdate:
             residual = x - self.codec.decode(enc).reshape(-1)
         return out, residual
 
-    def make_explicit_step(self, value_and_grad_fn, *, grad_clip=None):
+    def make_explicit_step(self, value_and_grad_fn, *, grad_clip=None,
+                           num_microbatches: int = 1):
         """Build the explicit per-shard train step.
 
         ``value_and_grad_fn(params_tree, mstate, data, labels, key) ->
         ((loss, new_mstate), grads)`` runs on the LOCAL batch shard with
         a per-shard PRNG key. Returns ``step(masters, mstate, opt_state,
         rng, data, labels, epoch) -> (new_masters, new_mstate,
-        new_opt_state, loss)`` ready for ``jax.jit``."""
+        new_opt_state, loss)`` ready for ``jax.jit``.
+
+        ``num_microbatches`` > 1 scans the local shard through fwd/bwd
+        in k strided microbatches with gradients accumulated in the
+        scan carry (optim/accumulation.py); the weight all-gather, the
+        bucketed compressed reduce-scatter (+ error feedback) and the
+        sharded update all fire ONCE per accumulated step — k times
+        fewer collective bytes per example."""
         ax, n = self.axis, self.n
         bkeys = list(self.buckets.keys)
         bspec = self.buckets.spec(P(ax))
+        k = int(num_microbatches)
 
         def body(masters, mstate, st, key, data, labels, epoch):
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
             full = {bk: self._gather_weights(masters[bk]) for bk in bkeys}
             p_tree = self.buckets.unflatten(full)
-            (loss, new_mstate), grads = value_and_grad_fn(
-                p_tree, mstate, data, labels, key)
+            if k == 1:
+                (loss, new_mstate), grads = value_and_grad_fn(
+                    p_tree, mstate, data, labels, key)
+            else:
+                from bigdl_tpu.optim.accumulation import \
+                    split_microbatches
+                ds = split_microbatches(data, k)
+                ls = split_microbatches(labels, k)
+                # microbatch key stream branched away from the bucket
+                # folds fold_in(key, 1+i) below — no key reuse across
+                # dropout draws and stochastic-rounding draws
+                mb_base = jax.random.fold_in(key, 0x6d62)
+
+                def mb(carry, xs):
+                    j, d, l = xs
+                    (lv, ms), g = value_and_grad_fn(
+                        p_tree, mstate, d, l,
+                        jax.random.fold_in(mb_base, j))
+                    gacc, lacc, msacc = carry
+                    gacc = jax.tree.map(jnp.add, gacc, g)
+                    msacc = jax.tree.map(
+                        lambda a, c: a + c / k
+                        if jnp.issubdtype(c.dtype, jnp.inexact) else c,
+                        msacc, ms)
+                    return (gacc, lacc + lv, msacc), None
+
+                out_s = jax.eval_shape(
+                    lambda p, d, l, kk: value_and_grad_fn(
+                        p, mstate, d, l, kk),
+                    p_tree, ds[0], ls[0], mb_base)
+                (loss_s, ms_s), g_s = out_s
+                zeros = lambda t: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), t)
+                (grads, lsum, new_mstate), _ = jax.lax.scan(
+                    mb, (zeros(g_s), zeros(loss_s), zeros(ms_s)),
+                    (jnp.arange(k, dtype=jnp.int32), ds, ls))
+                # per-microbatch losses/grads are local means over
+                # equal-sized microbatches: one division restores the
+                # local-batch mean exactly
+                grads = jax.tree.map(lambda g: g / k, grads)
+                loss = lsum / k
             loss = jax.lax.pmean(loss, ax)
             # per-shard batch statistics (the reference's per-core
             # semantics) merged across replicas; integer counters are

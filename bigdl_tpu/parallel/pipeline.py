@@ -25,13 +25,32 @@ from jax.sharding import Mesh, PartitionSpec as P
 from bigdl_tpu.parallel.collective import shard_map
 from bigdl_tpu.parallel.engine import get_mesh
 
-__all__ = ["pipeline_apply", "stack_layer_params"]
+__all__ = ["pipeline_apply", "stack_layer_params",
+           "pipeline_schedule_stats"]
 
 
 def stack_layer_params(params_list):
     """Stack per-layer param pytrees into one tree with a leading layer
     axis (what ``pipeline_apply`` consumes and what gets sharded)."""
     return jax.tree.map(lambda *ls: jnp.stack(ls), *params_list)
+
+
+def pipeline_schedule_stats(num_microbatches: int, n_stages: int) -> dict:
+    """Fill-drain cost of the GPipe schedule, as numbers instead of a
+    docstring claim: T = M + S - 1 ticks move M microbatches through S
+    stages, of which S - 1 are bubble (each stage idles while the
+    pipeline fills and drains), so ``bubble_fraction`` =
+    (S-1)/(M+S-1) of every device's tick budget is fill-drain cost.
+    ``pipeline_apply(..., with_stats=True)`` returns this dict next to
+    the result so runs REPORT the cost they pay."""
+    m, s = int(num_microbatches), int(n_stages)
+    if m < 1 or s < 1:
+        raise ValueError(f"need microbatches >= 1 and stages >= 1, got "
+                         f"M={m}, S={s}")
+    ticks = m + s - 1
+    return {"microbatches": m, "stages": s, "ticks": ticks,
+            "bubble_ticks": s - 1,
+            "bubble_fraction": (s - 1) / ticks}
 
 
 def _local_stack_apply(layer_apply, local_params, x):
@@ -46,7 +65,8 @@ def _local_stack_apply(layer_apply, local_params, x):
 
 def pipeline_apply(layer_apply, stacked_params, x, *,
                    num_microbatches: int, axis: str = "model",
-                   mesh: Mesh | None = None, data_axis: str | None = None):
+                   mesh: Mesh | None = None, data_axis: str | None = None,
+                   with_stats: bool = False):
     """Apply L stacked identical layers to ``x`` through an S-stage
     pipeline over mesh ``axis``.
 
@@ -61,6 +81,11 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
     runs its own fill-drain pipeline over its batch shard (params stay
     pipeline-sharded, replicated across ``data_axis``).
     ``num_microbatches`` must then divide the per-row batch shard.
+
+    ``with_stats=True`` returns ``(y, stats)`` where ``stats`` is
+    :func:`pipeline_schedule_stats` for this run's (M, S) — the
+    schedule's fill-drain bubble fraction (S-1)/(M+S-1) reported
+    instead of hidden (tests/test_pipeline_parallel.py pins it).
     """
     mesh = mesh or get_mesh()
     s = mesh.shape[axis]
@@ -114,8 +139,11 @@ def pipeline_apply(layer_apply, stacked_params, x, *,
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
     xspec = P() if data_axis is None else P(data_axis)
-    return shard_map(
+    y = shard_map(
         body, mesh=mesh,
         in_specs=(pspec, xspec),
         out_specs=xspec,
         check_rep=False)(stacked_params, x)
+    if with_stats:
+        return y, pipeline_schedule_stats(m, s)
+    return y

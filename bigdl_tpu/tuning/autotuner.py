@@ -39,7 +39,8 @@ __all__ = ["tune", "TuneResult", "Measurement", "VMEM_BUDGET_BYTES",
            "fused_ce_est_vmem", "lrn_candidates", "lrn_est_vmem",
            "maxpool_candidates", "bucket_mb_candidates",
            "batch_geometry_candidates", "tile_divisors",
-           "paged_attention_candidates", "paged_attention_est_vmem"]
+           "paged_attention_candidates", "paged_attention_est_vmem",
+           "step_memory_candidates", "step_memory_est_hbm"]
 
 logger = logging.getLogger("bigdl_tpu.tuning")
 
@@ -294,6 +295,42 @@ def paged_attention_est_vmem(s: int, d: int, dtype_bytes: int = 2):
         f32 = 4
         return (2 * r * s * f32 + r * (d + 2) * f32
                 + 2 * (2 * s * d + r * d) * dtype_bytes)
+    return est
+
+
+def step_memory_candidates(batch: int, *, policies=None,
+                           max_microbatches: int = 8) -> list[dict]:
+    """``(remat_policy, num_microbatches)`` grid for the train step's
+    memory-for-throughput knobs (optim/remat.py, optim/accumulation.py):
+    every known policy crossed with the powers of two dividing ``batch``
+    up to ``max_microbatches``. The measured ``tune()`` over these picks
+    the fastest step that FITS — more microbatches / heavier remat free
+    HBM for a larger per-chip batch at the cost of recompute and scan
+    overhead."""
+    from bigdl_tpu.optim.remat import known_remat_policies
+    if policies is None:
+        policies = known_remat_policies()
+    ks, k = [], 1
+    while k <= min(int(max_microbatches), int(batch)):
+        if batch % k == 0:
+            ks.append(k)
+        k *= 2
+    return [{"remat_policy": p, "num_microbatches": k}
+            for p in policies for k in ks]
+
+
+def step_memory_est_hbm(residual_bytes_by_policy: dict,
+                        persistent_bytes: int = 0):
+    """Static peak-HBM estimator for ``step_memory_candidates``
+    configs, from per-policy ``saved_residual_bytes`` measured once at
+    k=1 (optim/remat.py): the activation term scales with microbatch
+    size (1/k), the persistent term (params/grads/optimizer state) does
+    not. Use as ``est_vmem=`` with an HBM budget, or as ``est_cost=``
+    to order candidates memory-first."""
+    def est(c: dict) -> int:
+        rb = residual_bytes_by_policy[c["remat_policy"]]
+        return int(persistent_bytes + rb // max(int(
+            c.get("num_microbatches", 1)), 1))
     return est
 
 

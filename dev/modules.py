@@ -16,7 +16,8 @@ MODULES = {
     "dataset": ["tests/test_dataset_pipeline.py", "tests/test_recordio.py",
                 "tests/test_native_loader.py", "tests/test_prefetch.py"],
     "optim": ["tests/test_optim.py", "tests/test_checkpoint.py",
-              "tests/test_predictor.py", "tests/test_async_dispatch.py"],
+              "tests/test_predictor.py", "tests/test_async_dispatch.py",
+              "tests/test_accumulation.py"],
     "parameters": ["tests/test_compression.py",
                    "tests/test_sharded_update.py"],
     "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",

@@ -1,0 +1,362 @@
+"""On-device gradient accumulation contract (ISSUE 10 tentpole).
+
+The acceptance pin: accumulation(k) equals the single k×-batch step.
+Float addition is not associative, so the equality is pinned at two
+strengths (docs/PERFORMANCE.md "Remat & gradient accumulation"):
+
+- BIT-identical on an exactly-representable workload (dyadic params /
+  data, power-of-two normalizers, one step from the exact state —
+  denominators compound across steps, so exactness holds for exactly
+  one update): every float op is exact, so any machinery bug —
+  scaling, loss averaging, masked normalization, the update firing
+  more than once — breaks equality loudly, while the benign
+  partial-sum re-association cannot hide behind rounding because
+  there is none. Covered for BOTH optimizers, the replicated AND the
+  sharded-update (implicit + explicit-codec) paths.
+- tight-tolerance on multi-step real tanh-MLP trajectories, where the
+  only residual IS the re-association (~1 ulp per split reduction).
+
+Plus the edge cases: k=1 degenerates to the plain step (same AOT
+executable cache key — a warm cache cross-loads), k must divide the
+batch, accumulation composes bit-identically with async dispatch and
+the prefetch pipeline, and the collective wire bytes per accumulated
+step stay CONSTANT as k scales the effective batch (k× fewer wire
+bytes per example, read from the compiled HLO).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import Sample, SampleToBatch, array
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def _dyadic(a, denom=8):
+    """Snap to multiples of 1/denom — exactly representable in f32."""
+    return np.round(np.asarray(a, np.float64) * denom) / denom
+
+
+def make_exact_dataset(n=128, features=4, seed=0):
+    """Regression samples whose values are small dyadic rationals: all
+    forward/backward/update arithmetic on the linear model is EXACT in
+    f32, so bitwise comparisons test the machinery, not rounding."""
+    rs = np.random.RandomState(seed)
+    x = _dyadic(rs.randint(-4, 5, size=(n, features)) / 2.0, 2)
+    y = _dyadic(rs.randint(-4, 5, size=(n, features)) / 4.0, 4)
+    return array([Sample(x[i].astype(np.float32),
+                         y[i].astype(np.float32)) for i in range(n)])
+
+
+def exact_linear_model(features=4, seed=0):
+    model = nn.Sequential(nn.Linear(features, features))
+    model.materialize(jax.random.PRNGKey(seed))
+    q = jax.tree.map(
+        lambda a: jnp.asarray(_dyadic(a, 8).astype(np.float32)),
+        model.params)
+    model.sync(q, model.state)
+    return model
+
+
+def assert_tree_bits(a, b, what=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), what
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape, what
+        if x.dtype == np.float32:
+            assert np.array_equal(x.view(np.uint32), y.view(np.uint32)), \
+                (what, float(np.abs(x - y).max()))
+        else:
+            assert np.array_equal(x, y), what
+
+
+def run_exact(k, *, distri=False, iterations=1, batch=32,
+              pad=False, n=128, pad_full_size=None, **distri_kw):
+    """One training run on the exact workload; returns (params, losses).
+    The k×-batch reference is the SAME run with k=1 — identical batches
+    from the loop, the split is internal to the compiled step. ONE
+    iteration by default: from the dyadic state every float op of the
+    first step is exact (step 2 onward, squares of fine-grained values
+    round and the comparison honestly becomes the tolerance one)."""
+    Engine.reset()
+    if distri:
+        Engine.init()
+    RandomGenerator.set_seed(7)
+    np.random.seed(3)
+    model = exact_linear_model()
+    ds = make_exact_dataset(n=n) >> SampleToBatch(batch)
+    cls = DistriOptimizer if distri else optim.Optimizer
+    o = cls(model=model, dataset=ds, criterion=nn.MSECriterion(),
+            **distri_kw)
+    # lr/momentum powers of two: the update stays exact
+    o.set_optim_method(optim.SGD(learning_rate=0.125, momentum=0.5))
+    o.set_grad_accumulation(k)
+    if pad:
+        o.set_input_pipeline(pad_partial_batches=True)
+    if pad_full_size is not None:
+        # resume-path seam: fixes the padded shape so the very FIRST
+        # step is the masked one (exactness only holds for step 1)
+        o.set_state({"pad_full_size": pad_full_size})
+    o.set_end_when(optim.max_iteration(iterations))
+    losses = []
+    orig = o._emit_step
+
+    def spy(e, loss):
+        losses.append(loss)
+        orig(e, loss)
+
+    o._emit_step = spy
+    trained = o.optimize()
+    return trained.params, losses
+
+
+class TestBitIdenticalOnExactWorkload:
+    def test_local_k4_vs_single_step(self):
+        p1, l1 = run_exact(1)
+        p4, l4 = run_exact(4)
+        assert len(l1) == len(l4) == 1
+        assert l1 == l4
+        assert_tree_bits(p1, p4, "local k=4")
+
+    def test_local_k2_and_k8(self):
+        p1, l1 = run_exact(1)
+        for k in (2, 8):
+            pk, lk = run_exact(k)
+            assert l1 == lk, k
+            assert_tree_bits(p1, pk, f"local k={k}")
+
+    def test_distri_replicated_k2(self):
+        p1, l1 = run_exact(1, distri=True)
+        p2, l2 = run_exact(2, distri=True)
+        assert l1 == l2
+        assert_tree_bits(p1, p2, "distri k=2")
+
+    def test_distri_sharded_update_k2(self):
+        """Implicit sharded update: grads accumulate in global view,
+        the 1/N-sharded update math runs once per accumulated step."""
+        p1, l1 = run_exact(1, distri=True, shard_weight_update=True)
+        p2, l2 = run_exact(2, distri=True, shard_weight_update=True)
+        assert l1 == l2
+        assert_tree_bits(p1, p2, "sharded k=2")
+
+    def test_distri_explicit_fp32_codec_k2(self):
+        """Explicit per-shard construction: the scan runs inside
+        shard_map; gather + reduce-scatter + update fire once."""
+        p1, l1 = run_exact(1, distri=True, wire_codec="fp32")
+        p2, l2 = run_exact(2, distri=True, wire_codec="fp32")
+        assert l1 == l2
+        assert_tree_bits(p1, p2, "explicit fp32 k=2")
+
+    def test_masked_padding_k2(self):
+        """Short batch padded to 32 (MaskedCriterion): numerator and
+        valid count accumulate separately across microbatches and
+        divide ONCE — bitwise equal to the single padded step even
+        though per-microbatch valid counts differ from the batch's."""
+        # 24 valid rows padded to 32; k=2 microbatches carry 12 valid
+        # rows each but normalize by the accumulated 24, not their own
+        p1, l1 = run_exact(1, pad=True, n=24, pad_full_size=32)
+        p2, l2 = run_exact(2, pad=True, n=24, pad_full_size=32)
+        assert l1 == l2
+        assert_tree_bits(p1, p2, "masked k=2")
+
+
+def run_real(k, *, max_in_flight=1, depth=0, dropout=0.0, bn=False,
+             iterations=4):
+    Engine.reset()
+    RandomGenerator.set_seed(7)
+    np.random.seed(3)
+    rs = np.random.RandomState(0)
+    x = rs.rand(128, 8).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    ds = array([Sample(x[i], y[i]) for i in range(len(x))]) \
+        >> SampleToBatch(32)
+    layers = [nn.Linear(8, 16)]
+    if bn:
+        layers.append(nn.BatchNormalization(16))
+    layers.append(nn.Tanh())
+    if dropout > 0:
+        layers.append(nn.Dropout(dropout))
+    layers += [nn.Linear(16, 2), nn.LogSoftMax()]
+    model = nn.Sequential(*layers)
+    o = optim.Optimizer(model=model, dataset=ds,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(optim.SGD(learning_rate=0.5, momentum=0.9))
+    o.set_grad_accumulation(k)
+    o.set_async_dispatch(max_in_flight)
+    o.set_input_pipeline(depth=depth)
+    o.set_end_when(optim.max_iteration(iterations))
+    losses = []
+    orig = o._emit_step
+
+    def spy(e, loss):
+        losses.append(loss)
+        orig(e, loss)
+
+    o._emit_step = spy
+    trained = o.optimize()
+    return trained, losses
+
+
+class TestRealModelTolerance:
+    def test_tanh_mlp_k2_matches_within_reassociation(self):
+        """On a real model the ONLY difference is partial-sum
+        re-association inside the batch reductions — pinned tight."""
+        m1, l1 = run_real(1)
+        m2, l2 = run_real(2)
+        assert len(l1) == len(l2) == 4
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(m1.params),
+                        jax.tree.leaves(m2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6)
+
+    def test_batchnorm_stats_averaged_across_microbatches(self):
+        """BN batch statistics are per-microbatch (documented); the
+        running MEAN still lands on the full-batch value because equal
+        microbatch means average to the batch mean exactly."""
+        m1, _ = run_real(1, bn=True)
+        m2, _ = run_real(2, bn=True)
+        rm1 = np.asarray(m1.state["1"]["running_mean"])
+        rm2 = np.asarray(m2.state["1"]["running_mean"])
+        np.testing.assert_allclose(rm1, rm2, rtol=2e-2, atol=1e-4)
+
+    def test_dropout_deterministic_per_microbatch_keys(self):
+        """Per-microbatch RNG: fold_in(step_rng, j) — two identical
+        runs replay the same mask sequence."""
+        _, l1 = run_real(2, dropout=0.5)
+        _, l2 = run_real(2, dropout=0.5)
+        assert l1 == l2
+
+
+class TestEdgeCases:
+    def _mlp_optimizer(self, **kw):
+        RandomGenerator.set_seed(1)
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 4).astype(np.float32)
+        y = (x[:, 0] > 0.5).astype(np.int64) + 1
+        ds = array([Sample(x[i], y[i]) for i in range(len(x))]) \
+            >> SampleToBatch(32)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                              nn.Linear(8, 2), nn.LogSoftMax())
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion(), **kw)
+        o.set_optim_method(optim.SGD(learning_rate=0.5))
+        o.set_end_when(optim.max_iteration(2))
+        return o
+
+    def test_k_must_be_positive(self):
+        o = self._mlp_optimizer()
+        with pytest.raises(ValueError, match=">= 1"):
+            o.set_grad_accumulation(0)
+        with pytest.raises(ValueError, match=">= 1"):
+            optim.Optimizer(model=nn.Linear(2, 2), dataset=None,
+                            criterion=None, grad_accumulation=-1)
+
+    def test_k_not_dividing_batch_raises_clearly(self):
+        o = self._mlp_optimizer()
+        o.set_grad_accumulation(5)      # batch 32
+        with pytest.raises(ValueError, match="not divisible"):
+            o.optimize()
+
+    def test_k1_same_cache_key_as_unconfigured(self):
+        """k=1 IS the plain step: identical AOT-cache key material, so
+        a warm cache written by a k=1 run loads into a run that never
+        configured accumulation (and vice versa)."""
+        o_def = self._mlp_optimizer()
+        o_k1 = self._mlp_optimizer()
+        o_k1.set_grad_accumulation(1)
+        assert o_def._step_key_extra() == o_k1._step_key_extra()
+        o_k2 = self._mlp_optimizer()
+        o_k2.set_grad_accumulation(2)
+        assert o_def._step_key_extra() != o_k2._step_key_extra()
+        o_pol = self._mlp_optimizer()
+        o_pol.set_remat_policy("per_block")
+        assert o_def._step_key_extra() != o_pol._step_key_extra()
+
+    def test_k1_warm_cache_cross_loads(self, tmp_path):
+        from bigdl_tpu.tuning.aot_cache import AOTCache
+        c1 = AOTCache(str(tmp_path))
+        o1 = self._mlp_optimizer()
+        o1.set_grad_accumulation(1)
+        o1.set_aot_cache(c1)
+        o1.optimize()
+        assert c1.misses >= 1
+        c2 = AOTCache(str(tmp_path))
+        o2 = self._mlp_optimizer()          # accumulation never set
+        o2.set_aot_cache(c2)
+        o2.optimize()
+        assert c2.hits >= 1 and c2.misses == 0
+
+    def test_composes_with_async_dispatch_and_prefetch(self):
+        """Same compiled step either way — the loop plumbing around it
+        (dispatch window, prefetch worker) must not change results."""
+        m_sync, l_sync = run_real(2, max_in_flight=1, depth=0)
+        m_async, l_async = run_real(2, max_in_flight=2, depth=2)
+        assert l_sync == l_async
+        assert_tree_bits(m_sync.params, m_async.params, "async+prefetch")
+
+
+class TestCollectiveAmortization:
+    def test_wire_bytes_per_step_constant_in_k(self):
+        """The receipt on collective traffic: the explicit sharded step
+        at k=2 over a 2x batch moves the SAME wire bytes per step as
+        k=1 over the base batch — k times fewer bytes per example —
+        read statically from the compiled HLO."""
+        Engine.init()
+        from bigdl_tpu.optim.sgd import SGD
+        from bigdl_tpu.optim.sharded_update import ShardedWeightUpdate
+        from bigdl_tpu.parallel.collective_bench import collective_bytes
+        from bigdl_tpu.parallel.engine import (data_sharding, get_mesh,
+                                               replicated)
+
+        mesh = get_mesh()
+        n = int(mesh.shape["data"])
+        rs = np.random.RandomState(0)
+        params = {"w": rs.randn(64, 64).astype(np.float32) * 0.05,
+                  "b": np.zeros(64, np.float32)}
+
+        def vag(p, mstate, data, labels, key):
+            def loss_fn(pp):
+                return jnp.mean(
+                    (data @ pp["w"] + pp["b"] - labels) ** 2), mstate
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(p)
+
+        def step_bytes(k, batch):
+            su = ShardedWeightUpdate(mesh, SGD(learning_rate=0.1),
+                                     params, wire_codec="bf16",
+                                     bucket_mb=0.25)
+            step = su.make_explicit_step(vag, num_microbatches=k)
+            masters = su.import_params(params)
+            opt0 = su.import_opt_state(
+                su.optim.init_state(params), params)
+            data = jax.device_put(
+                jnp.asarray(rs.rand(batch, 64).astype(np.float32)),
+                data_sharding(mesh))
+            labels = jax.device_put(
+                jnp.asarray(rs.rand(batch, 64).astype(np.float32)),
+                data_sharding(mesh))
+            compiled = jax.jit(step).lower(
+                masters, {}, opt0, jax.random.PRNGKey(0), data, labels,
+                jax.device_put(jnp.ones((), jnp.int32),
+                               replicated(mesh))).compile()
+            return collective_bytes(compiled.as_text(), n)
+
+        base = step_bytes(1, 128)
+        accum = step_bytes(2, 256)      # 2x the examples, same wire
+        assert accum["wire_bytes_per_chip"] == \
+            base["wire_bytes_per_chip"]
+        assert accum["ops"] == base["ops"]

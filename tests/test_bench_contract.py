@@ -699,6 +699,217 @@ class TestServingDecodeHBMRow:
         assert row["bytes_accessed_dense_exec"] > 0
 
 
+class TestTrainPeakHbmRow:
+    """ISSUE 10: train_peak_hbm_bytes — static peak-HBM accounting of
+    the transformer train step across remat policies at fixed effective
+    batch, plus the accumulation scan's executable temp shrink — rides
+    the standard row/known/all contract."""
+
+    FAKE = {"metric": "train_peak_hbm_bytes", "value": 2.5,
+            "unit": "x (peak HBM none / nothing_saveable, fixed "
+                    "effective batch)",
+            "peak_hbm_bytes_none": 100.0,
+            "peak_hbm_bytes_nothing_saveable": 40.0,
+            "accum_temp_reduction": 3.0}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_train_peak_hbm",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "train_peak_hbm_bytes",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "train_peak_hbm_bytes"
+        assert lines[-1]["rows"][0]["value"] == 2.5
+        with open(out) as f:
+            assert "bench_train_peak_hbm_bytes 2.5" in f.read()
+
+    def test_row_in_all(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        metrics = [r["metric"] for r in agg["rows"]]
+        assert "train_peak_hbm_bytes" in metrics
+        assert "multichip_scaling" in metrics
+
+    def test_real_probe_tiny_geometry_in_process(self):
+        """The underlying probe at tiny geometry, in-process (no
+        subprocess): the acceptance bar — nothing_saveable frees
+        >= 1.5x peak HBM vs none at fixed effective batch — holds even
+        here, and the k-microbatch scan shrinks the compiled
+        executable's temp buffers."""
+        from bigdl_tpu.optim.remat import train_memory_probe
+        out = train_memory_probe(d_model=32, num_layers=2, seq=64,
+                                 batch=8, vocab=64, accum_k=2)
+        peak = out["peak_hbm_bytes"]
+        assert peak["none"] > peak["per_block"] > \
+            peak["nothing_saveable"]
+        assert out["reduction"] >= 1.5
+        assert out["accum_temp_reduction"] is not None
+        assert out["accum_temp_reduction"] > 1.0
+
+    @pytest.mark.slow
+    def test_real_subprocess_probe(self):
+        row = bench.bench_train_peak_hbm(d_model=32, num_layers=2,
+                                         seq=64, batch=8, vocab=64,
+                                         accum_k=2)
+        assert row["metric"] == "train_peak_hbm_bytes"
+        assert row["value"] >= 1.5
+        assert row["peak_hbm_bytes_none"] > \
+            row["peak_hbm_bytes_nothing_saveable"]
+
+
+class TestMultichipScalingRow:
+    """ROADMAP item 5 satellite: multichip_scaling — per-chip
+    throughput ratio vs ideal across 1/2/4/8-device CPU meshes, one
+    subprocess per mesh size."""
+
+    FAKE = {"metric": "multichip_scaling", "value": 0.5,
+            "unit": "per-chip throughput ratio vs ideal at 8 devices",
+            "device_counts": [1, 2, 4, 8],
+            "per_chip_img_per_sec": {"1": 100.0, "8": 50.0},
+            "ratio_vs_ideal": {"1": 1.0, "8": 0.5},
+            "cpu_mesh_emulated": True}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_multichip_scaling",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "multichip_scaling",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "multichip_scaling"
+        assert lines[-1]["rows"][0]["value"] == 0.5
+        with open(out) as f:
+            assert "bench_multichip_scaling 0.5" in f.read()
+
+    def test_xla_flags_device_count_override(self, monkeypatch):
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_foo=1 --xla_force_host_platform_device_count=8")
+        flags = bench._xla_flags_with_device_count(2)
+        assert "--xla_force_host_platform_device_count=2" in flags
+        assert "count=8" not in flags
+        assert "--xla_foo=1" in flags
+
+    @pytest.mark.slow
+    def test_real_probe_two_mesh_sizes(self):
+        """A REAL pair of subprocess probes: wiring + the ratio math
+        (per-chip at N=2 relative to N=1; the shared-core CPU mesh
+        makes the ideal unreachable — the row documents that)."""
+        row = bench.bench_multichip_scaling(device_counts=(1, 2),
+                                            batch_per_chip=16, iters=3)
+        assert row["metric"] == "multichip_scaling"
+        assert row["device_counts"] == [1, 2]
+        assert row["ratio_vs_ideal"]["1"] == 1.0
+        assert 0 < row["value"] <= 1.5
+        assert row["cpu_mesh_emulated"] is True
+
+
+class TestDefaultGate:
+    """ISSUE 10 satellite (ROADMAP item 5): a CLI invocation gates
+    against the committed BASELINE.json by default — --no-gate opts
+    out, and a legacy/non-gate-format file skips with a note instead
+    of failing every run."""
+
+    ROW = {"metric": "transformer_lm_train_tokens_per_sec_per_chip",
+           "value": 100.0, "unit": "tokens/sec/chip"}
+
+    def _arm(self, monkeypatch, argv):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_transformer_lm",
+                            lambda: dict(self.ROW))
+        import sys as _sys
+        monkeypatch.setattr(_sys, "argv", ["bench.py"] + argv)
+
+    def _gate_rows(self, capsys):
+        return [line for line in _parse_lines(capsys.readouterr().out)
+                if line.get("metric") == "bench_gate"]
+
+    def test_cli_run_gates_against_recorded_baseline(self, monkeypatch,
+                                                     capsys, tmp_path):
+        path = tmp_path / "BASELINE.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            self.ROW["metric"]: {"value": 100.0}}}))
+        monkeypatch.setattr(bench, "DEFAULT_BASELINE", str(path))
+        self._arm(monkeypatch, ["--rows", "transformer"])
+        bench.main(None)                      # argv=None: the CLI path
+        gates = self._gate_rows(capsys)
+        assert gates and gates[0]["value"] == 1.0
+        assert gates[0]["baseline"] == str(path)
+
+    def test_cli_slowdown_fails_default_gate(self, monkeypatch, capsys,
+                                             tmp_path):
+        path = tmp_path / "BASELINE.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            self.ROW["metric"]: {"value": 1000.0}}}))
+        monkeypatch.setattr(bench, "DEFAULT_BASELINE", str(path))
+        self._arm(monkeypatch, ["--rows", "transformer"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main(None)
+        assert ei.value.code == bench.GATE_EXIT_CODE
+
+    def test_no_gate_flag_opts_out(self, monkeypatch, capsys, tmp_path):
+        path = tmp_path / "BASELINE.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            self.ROW["metric"]: {"value": 1000.0}}}))
+        monkeypatch.setattr(bench, "DEFAULT_BASELINE", str(path))
+        self._arm(monkeypatch, ["--rows", "transformer", "--no-gate"])
+        bench.main(None)                      # would exit 4 if gated
+        assert self._gate_rows(capsys) == []
+
+    def test_legacy_metadata_baseline_skips_with_note(self, monkeypatch,
+                                                      capsys, tmp_path):
+        """The repo's seed-era BASELINE.json (reference metadata, no
+        'rows') must not arm the gate — skipped loudly on stderr."""
+        path = tmp_path / "BASELINE.json"
+        path.write_text(json.dumps({"metric": "legacy", "published": {}}))
+        monkeypatch.setattr(bench, "DEFAULT_BASELINE", str(path))
+        self._arm(monkeypatch, ["--rows", "transformer"])
+        bench.main(None)
+        captured = capsys.readouterr()
+        assert self._gate_rows_from(captured.out) == []
+        assert "not a recorded gate baseline" in captured.err
+
+    @staticmethod
+    def _gate_rows_from(out):
+        return [line for line in _parse_lines(out)
+                if line.get("metric") == "bench_gate"]
+
+    def test_explicit_argv_runs_never_auto_gate(self, monkeypatch,
+                                                capsys, tmp_path):
+        """Embedding callers (and this test suite) pass explicit argv —
+        the default gate must not surprise them."""
+        path = tmp_path / "BASELINE.json"
+        path.write_text(json.dumps({"version": 1, "rows": {
+            self.ROW["metric"]: {"value": 1000.0}}}))
+        monkeypatch.setattr(bench, "DEFAULT_BASELINE", str(path))
+        self._arm(monkeypatch, [])
+        bench.main(["--rows", "transformer"])   # no SystemExit(4)
+        assert self._gate_rows(capsys) == []
+
+    def test_is_gate_baseline_format_check(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"rows": {"m": {"value": 1.0}}}))
+        assert bench._is_gate_baseline(str(good))
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"published": {}}))
+        assert not bench._is_gate_baseline(str(bad))
+        assert not bench._is_gate_baseline(str(tmp_path / "absent.json"))
+        notjson = tmp_path / "nj.json"
+        notjson.write_text("{oops")
+        assert not bench._is_gate_baseline(str(notjson))
+
+
 def _get(url):
     from urllib.request import urlopen
     with urlopen(url, timeout=10) as r:

@@ -463,6 +463,84 @@ class TestJX5HostOnlyImports:
             assert [f for f in found if f.rule == "JX5"] == [], path
 
 
+class TestAccumulationScanBodyFixtures:
+    """ISSUE 10 satellite: pin the TPU-correctness contract of the
+    gradient-accumulation scan body (optim/accumulation.py) — no hidden
+    host syncs inside the scan (JX1), donation respected around the
+    accumulating step (JX3) — and that the SHIPPED module is clean."""
+
+    def test_host_sync_inside_scan_body_fires_jx1(self):
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def accumulate(params, xs):
+                def body(carry, x):
+                    g = float(jnp.sum(x))     # per-microbatch readback
+                    return carry + g, None
+                out, _ = jax.lax.scan(body, 0.0, xs)
+                return out
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_accumulation_shaped_scan_body_is_clean(self):
+        """The shape of the real scan body — tree adds in the carry,
+        fold_in-derived per-microbatch keys, no host conversions."""
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def accumulate(mb_vag, k, params, data, rng):
+                def body(carry, xs):
+                    j, d = xs
+                    key = jax.random.fold_in(rng, j)
+                    (num, ms), g = mb_vag(params, j, d, key)
+                    gacc, nacc = carry
+                    gacc = jax.tree.map(jnp.add, gacc, g)
+                    return (gacc, nacc + num), None
+                zero = jax.tree.map(jnp.zeros_like, params)
+                (g, n), _ = jax.lax.scan(
+                    body, (zero, jnp.zeros(())),
+                    (jnp.arange(k), data))
+                return n, g
+        """)
+        assert out == []
+
+    def test_reading_donated_params_after_accum_step_fires_jx3(self):
+        out = lint("""
+            import jax
+
+            def train(step, params, batches):
+                jit_step = jax.jit(step, donate_argnums=(0,))
+                for b in batches:
+                    new_params = jit_step(params, b)
+                return params, new_params
+        """)
+        assert "JX3" in rules(out)
+
+    def test_rebinding_accum_step_results_is_clean(self):
+        """The optimizer loop's actual pattern: params/opt_state rebound
+        from every accumulated-step call."""
+        out = lint("""
+            import jax
+
+            def train(step, params, opt_state, batches):
+                jit_step = jax.jit(step, donate_argnums=(0, 1))
+                for b in batches:
+                    params, opt_state = jit_step(params, opt_state, b)
+                return params, opt_state
+        """)
+        assert out == []
+
+    def test_shipped_accumulation_module_is_clean(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ("bigdl_tpu/optim/accumulation.py",
+                    "bigdl_tpu/optim/remat.py"):
+            path = os.path.join(repo, *rel.split("/"))
+            assert os.path.exists(path), path
+            assert jaxlint.analyze_file(path, repo) == [], rel
+
+
 class TestSuppressions:
     def test_disable_silences_named_rule(self):
         out = lint("""

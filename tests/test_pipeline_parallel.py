@@ -6,7 +6,50 @@ import jax.numpy as jnp
 import pytest
 
 from bigdl_tpu.parallel.engine import Engine
-from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_layer_params
+from bigdl_tpu.parallel.pipeline import (pipeline_apply,
+                                         pipeline_schedule_stats,
+                                         stack_layer_params)
+
+
+class TestScheduleStats:
+    """ISSUE 10 satellite: the GPipe fill-drain cost is a RETURNED stat,
+    not a docstring claim — bubble fraction (S-1)/(M+S-1) pinned."""
+
+    @pytest.mark.parametrize("m,s,frac", [
+        (4, 4, 3 / 7), (8, 8, 7 / 15), (8, 2, 1 / 9), (1, 4, 3 / 4),
+        (16, 1, 0.0)])
+    def test_bubble_fraction_formula(self, m, s, frac):
+        st = pipeline_schedule_stats(m, s)
+        assert st["ticks"] == m + s - 1
+        assert st["bubble_ticks"] == s - 1
+        assert st["bubble_fraction"] == pytest.approx(frac)
+
+    def test_more_microbatches_shrink_the_bubble(self):
+        fracs = [pipeline_schedule_stats(m, 4)["bubble_fraction"]
+                 for m in (1, 2, 4, 8, 32)]
+        assert fracs == sorted(fracs, reverse=True)
+        assert fracs[-1] < 0.1 < fracs[0]
+
+    def test_rejects_degenerate(self):
+        with pytest.raises(ValueError, match="microbatches"):
+            pipeline_schedule_stats(0, 4)
+
+    def test_pipeline_apply_returns_stats(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"model": 4},
+                           devices=jax.devices()[:4])
+        stacked, layers = _make()
+        x = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((16, 16)).astype(np.float32))
+        y, st = pipeline_apply(_layer_apply, stacked, x,
+                               num_microbatches=4, mesh=mesh,
+                               with_stats=True)
+        ref = _serial(layers, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert st == pipeline_schedule_stats(4, 4)
+        assert st["bubble_fraction"] == pytest.approx(3 / 7)
+        Engine.reset()
 
 
 def _layer_apply(p, h):

@@ -166,6 +166,35 @@ class TestTune:
         assert est({"bq": 512, "bk": 1024}) > est({"bq": 128, "bk": 128})
         assert {"bucket_mb": 4.0} in bucket_mb_candidates()
 
+    def test_step_memory_candidates_and_est(self):
+        """ISSUE 10: the (remat_policy, num_microbatches) search axes —
+        every known policy crossed with batch-dividing power-of-two k,
+        and the static HBM estimator scaling the residual term 1/k."""
+        from bigdl_tpu.tuning.autotuner import (step_memory_candidates,
+                                                step_memory_est_hbm)
+        cands = step_memory_candidates(32)
+        assert {"remat_policy": "none", "num_microbatches": 1} in cands
+        assert {"remat_policy": "nothing_saveable",
+                "num_microbatches": 8} in cands
+        ks = {c["num_microbatches"] for c in cands}
+        assert ks == {1, 2, 4, 8}             # powers of two dividing 32
+        pols = {c["remat_policy"] for c in cands}
+        assert pols == {"none", "dots_saveable", "per_block",
+                        "nothing_saveable"}
+        # k legality follows the batch: 24 admits 1/2/4/8, 6 only 1/2
+        assert {c["num_microbatches"]
+                for c in step_memory_candidates(6)} == {1, 2}
+        est = step_memory_est_hbm({"none": 1000, "nothing_saveable": 100},
+                                  persistent_bytes=50)
+        assert est({"remat_policy": "none", "num_microbatches": 1}) == 1050
+        assert est({"remat_policy": "none", "num_microbatches": 4}) == 300
+        assert est({"remat_policy": "nothing_saveable",
+                    "num_microbatches": 1}) == 150
+        # ordering: heavier policy + more microbatches = smaller estimate
+        assert est({"remat_policy": "nothing_saveable",
+                    "num_microbatches": 4}) < \
+            est({"remat_policy": "none", "num_microbatches": 4})
+
 
 # ---------------------------------------------------------------------------
 # kernel pickers consult records / flash divisor fallback
