@@ -55,6 +55,14 @@ def _chip_peak_tflops() -> float | None:
     return None
 
 
+def _cost_dict(cost):
+    """``Executable.cost_analysis()`` compat: newer jax returns a dict,
+    older a [dict] per device — normalize to a dict (or None)."""
+    if isinstance(cost, (list, tuple)):
+        return cost[0] if cost else None
+    return cost
+
+
 def _set_bf16_policy():
     import jax.numpy as jnp
     from bigdl_tpu.tensor import DTypePolicy, set_policy
@@ -153,7 +161,7 @@ def bench_convnet_synthetic(model_name: str, batch: int = BATCH,
     # the timed loop (avoids any chance of a second trace/compile)
     compiled = jit_step.lower(params, mstate, opt_state, rng, data,
                               labels).compile()
-    cost = compiled.cost_analysis()
+    cost = _cost_dict(compiled.cost_analysis())
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
     _record_compile_telemetry(f"bench_{model_name}_train_step", compiled)
 
@@ -537,6 +545,72 @@ def _scaling_probe_main(n: int, batch_per_chip: int, iters: int):
     _emit({"devices": n, "images_per_sec": batch * iters / dt})
 
 
+def _pipeline_bubble_geometry() -> dict:
+    # tiny fixed (S, M) geometry: big enough that the modeled bubbles
+    # separate (gpipe 3/11 vs interleaved-1F1B 3/19), small enough that
+    # the probe's jitted units compile in seconds on one CPU core
+    return dict(n_stages=4, num_microbatches=8, virtual_stages=2,
+                d_model=16, mb_rows=4, layers_per_stage=2, reps=5)
+
+
+def bench_pipeline_bubble(**geometry):
+    """Measured pipeline-schedule bubble fractions (ISSUE 11): real
+    per-stage forward/backward span timings (jitted chunk units on the
+    CPU backend, median of reps) composed through each schedule's exact
+    dependency graph (``parallel.pipeline.measure_pipeline_bubble``),
+    vs the extended ``pipeline_schedule_stats`` model. Runs in a CPU
+    SUBPROCESS like the other static probes. ``value`` is the measured
+    interleaved-1F1B bubble fraction — the production schedule — which
+    must land strictly below GPipe's at the same (S, M) and within
+    tolerance of the model (test_bench_contract.py pins both). Lower is
+    better; the gate knows."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    geo = dict(_pipeline_bubble_geometry(), **geometry)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--pipeline-bubble-probe",
+         "--pipeline-bubble-geometry", json.dumps(geo)],
+        capture_output=True, text=True, timeout=600, env=env)
+    payload = None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            payload = json.loads(line)
+    if payload is None:
+        tail = (out.stderr or "").strip().splitlines()[-2:]
+        raise RuntimeError(
+            f"pipeline-bubble probe subprocess rc={out.returncode}: "
+            + (" | ".join(tail) or "no output"))
+    sch = payload["schedules"]
+    row = {
+        "metric": "pipeline_bubble_fraction",
+        "value": round(
+            sch["interleaved_1f1b"]["measured_bubble_fraction"], 4),
+        "unit": "measured interleaved-1F1B bubble fraction "
+                "(fill-drain idle share; lower is better)",
+        "n_stages": payload["n_stages"],
+        "num_microbatches": payload["num_microbatches"],
+        "virtual_stages": payload["virtual_stages"],
+        "geometry": payload["geometry"],
+    }
+    for name, r in sch.items():
+        row[f"measured_{name}"] = round(r["measured_bubble_fraction"], 4)
+        row[f"modeled_{name}"] = round(r["modeled_bubble_fraction"], 4)
+    row["fwd_span_us"] = round(
+        sch["1f1b"]["fwd_span_s"] * 1e6, 1)
+    row["bwd_span_us"] = round(
+        sch["1f1b"]["bwd_span_s"] * 1e6, 1)
+    return row
+
+
+def _pipeline_bubble_probe_main(geometry_json: str):
+    """--pipeline-bubble-probe subprocess entry: time the per-stage
+    units on the CPU backend and emit the per-schedule measured/modeled
+    bubble JSON."""
+    from bigdl_tpu.parallel.pipeline import measure_pipeline_bubble
+    _emit(measure_pipeline_bubble(**json.loads(geometry_json or "{}")))
+
+
 def _wire_probe_geometry() -> dict:
     return dict(d_in=256, d_hidden=1024, layers=3, batch=512,
                 bucket_kb=512)
@@ -840,7 +914,7 @@ def bench_transformer_lm(b: int = 4, s: int = 2048, vocab: int = 32768,
     labels = jnp.asarray(host.integers(1, vocab + 1, size=(b, s)))
     c = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
         params, mstate, opt_state, data, labels).compile()
-    cost = c.cost_analysis()
+    cost = _cost_dict(c.cost_analysis())
     xla_flops = float(cost.get("flops", 0.0)) if cost else 0.0
     _record_compile_telemetry("bench_transformer_lm_train_step", c)
     # analytic step FLOPs: matmul params = 2-D weight leaves minus the
@@ -1331,7 +1405,8 @@ GATE_DEFAULT_MIN_RATIO = 0.8
 # metrics where a SMALLER value is the better one; everything else
 # (throughput-style rows) gates higher-is-better. Baseline entries can
 # override with an explicit "direction".
-_GATE_LOWER_IS_BETTER = {"serving_ttft"}
+_GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
+                         "collective_wire_bytes_per_step"}
 
 GATE_EXIT_CODE = 4
 
@@ -1488,7 +1563,8 @@ def main(argv=None):
                              "collective_wire_bytes_per_step,"
                              "compile_cold_start,"
                              "serving_decode_hbm_bytes,"
-                             "train_peak_hbm_bytes,multichip_scaling")
+                             "train_peak_hbm_bytes,multichip_scaling,"
+                             "pipeline_bubble_fraction")
     parser.add_argument("--gate", default=None, metavar="BASELINE_JSON",
                         help="compare this run's rows against a "
                              "recorded baseline (per-row thresholds); "
@@ -1544,6 +1620,10 @@ def main(argv=None):
                         help=argparse.SUPPRESS)
     parser.add_argument("--scaling-probe", type=int, default=None,
                         help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--pipeline-bubble-probe", action="store_true",
+                        help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--pipeline-bubble-geometry", default="{}",
+                        help=argparse.SUPPRESS)
     parser.add_argument("--scaling-batch-per-chip", type=int, default=64,
                         help=argparse.SUPPRESS)
     parser.add_argument("--scaling-iters", type=int, default=8,
@@ -1583,6 +1663,9 @@ def main(argv=None):
         _scaling_probe_main(args.scaling_probe,
                             args.scaling_batch_per_chip,
                             args.scaling_iters)
+        return
+    if args.pipeline_bubble_probe:
+        _pipeline_bubble_probe_main(args.pipeline_bubble_geometry)
         return
     global _metrics_server
     if args.serve_metrics is not None:
@@ -1643,7 +1726,8 @@ def _run(args):
                 "serving_tokens_per_sec",
                 "collective_wire_bytes_per_step",
                 "compile_cold_start", "serving_decode_hbm_bytes",
-                "train_peak_hbm_bytes", "multichip_scaling"]
+                "train_peak_hbm_bytes", "multichip_scaling",
+                "pipeline_bubble_fraction"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -1651,7 +1735,7 @@ def _run(args):
              "serving_ttft", "serving_tokens_per_sec", "train_mfu",
              "collective_wire_bytes_per_step", "compile_cold_start",
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
-             "multichip_scaling"}
+             "multichip_scaling", "pipeline_bubble_fraction"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -1703,6 +1787,7 @@ def _run(args):
         "serving_decode_hbm_bytes": bench_serving_decode_hbm,
         "train_peak_hbm_bytes": bench_train_peak_hbm,
         "multichip_scaling": bench_multichip_scaling,
+        "pipeline_bubble_fraction": bench_pipeline_bubble,
     }
     rows_out: list[dict] = []
     headline_failed = False

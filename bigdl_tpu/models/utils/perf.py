@@ -154,7 +154,8 @@ def _transformer_perf(args):
     if not np.isfinite(final):
         raise SystemExit(f"transformer perf run diverged: loss={final} "
                          f"(throughput would be meaningless)")
-    cost = c.cost_analysis()
+    from bigdl_tpu.observability.compile_watch import executable_stats
+    cost = executable_stats(c)
     line = (f"transformer: {b * s * args.iteration / dt:,.0f} tokens/s "
             f"({dt / args.iteration * 1000:.1f} ms/step, B{b} S{s} "
             f"vocab {vocab}, final loss {final:.3f})")
@@ -322,8 +323,9 @@ def main(argv=None):
             f"records/second ({dt / args.iteration * 1000:.2f} ms/iteration)")
     # reuses the dispatch-cache entry populated by the loop above — no
     # second compile (verified on jax 0.9)
-    cost = jit_step.lower(params, mstate, opt_state, rng, data,
-                          labels).compile().cost_analysis()
+    from bigdl_tpu.observability.compile_watch import executable_stats
+    cost = executable_stats(jit_step.lower(params, mstate, opt_state,
+                                           rng, data, labels).compile())
     if cost and cost.get("flops"):
         tflops = cost["flops"] * args.iteration / dt / 1e12
         line += f" [{tflops:.1f} TFLOP/s achieved]"

@@ -157,7 +157,8 @@ def finalize_accumulated(num, weight, grads, *, k: int,
 
 
 def make_train_step(*, fwd, criterion, masked=None, input_transform=None,
-                    grad_clip=None, update_fn, num_microbatches: int = 1):
+                    grad_clip=None, update_fn, num_microbatches: int = 1,
+                    aux_loss=None):
     """Construct the train step both optimizers compile:
     ``step(params, mstate, opt_state, rng, data, labels, epoch,
     n_valid=None) -> (params, mstate, opt_state, loss)``.
@@ -166,7 +167,11 @@ def make_train_step(*, fwd, criterion, masked=None, input_transform=None,
     (optim/remat.py), ``update_fn(grads, params, opt_state) ->
     (new_params, new_opt_state)`` the optimizer update (the sharded
     update's ``apply_update`` on that path), ``masked`` the
-    ``MaskedCriterion`` when partial-batch padding is on.
+    ``MaskedCriterion`` when partial-batch padding is on. ``aux_loss``
+    (``set_expert_parallel``) maps the forward's new module state to an
+    auxiliary objective term — the MoE load-balancing loss riding the
+    state — added to the criterion (and, under accumulation, averaged
+    across microbatches with the rest of the loss).
 
     ``num_microbatches == 1`` builds EXACTLY the pre-accumulation
     program — same ops in the same order, so golden training fixtures
@@ -177,6 +182,12 @@ def make_train_step(*, fwd, criterion, masked=None, input_transform=None,
     from bigdl_tpu.optim.optimizer import _clip_gradients
     k = int(num_microbatches)
     use_mask = masked is not None
+    if use_mask and aux_loss is not None:
+        raise ValueError(
+            "expert_parallel's aux loss does not compose with "
+            "pad_partial_batches: the masked numerator/denominator "
+            "normalization cannot carry the per-microbatch aux term — "
+            "disable padding or the aux loss")
     size_avg = getattr(criterion, "size_average", True)
 
     if k == 1:
@@ -194,7 +205,10 @@ def make_train_step(*, fwd, criterion, masked=None, input_transform=None,
                     # loss and gradient (nn.MaskedCriterion)
                     mask = jnp.arange(data.shape[0]) < n_valid
                     return masked.apply(y, labels, mask), new_mstate
-                return criterion.apply(y, labels), new_mstate
+                loss = criterion.apply(y, labels)
+                if aux_loss is not None:
+                    loss = loss + aux_loss(new_mstate)
+                return loss, new_mstate
 
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -224,6 +238,10 @@ def make_train_step(*, fwd, criterion, masked=None, input_transform=None,
                     num, cnt = masked.masked_sum(y, l, mask)
                 else:
                     num = criterion.apply(y, l)
+                    if aux_loss is not None:
+                        # per-microbatch aux joins the numerator; the
+                        # final /k restores its mean like the loss
+                        num = num + aux_loss(new_mstate)
                     cnt = jnp.ones((), num.dtype)
                 return num, (cnt, new_mstate)
 

@@ -105,6 +105,83 @@ class DistriOptimizer(Optimizer):
             "per chip (ring estimate)", acct["ops"],
             acct["logical_bytes"] / 1e6, acct["wire_bytes_per_chip"] / 1e6)
 
+    def _init_pipeline(self, mesh):
+        """Validate + build the PipelineParallel mechanics (None when
+        pipeline_stages == 1). The pipeline path owns its own layouts,
+        so the features that assume a replicated or data-only layout
+        are refused loudly here."""
+        if self.pipeline_stages <= 1:
+            return None
+        if self.tensor_parallel or self.sequence_parallel:
+            raise ValueError(
+                "pipeline_stages shards the layer stack over the "
+                "'pipe' axis and does not compose with "
+                "tensor_parallel/sequence_parallel yet — pick one "
+                "model-sharding scheme")
+        if self.shard_optim_state:
+            raise ValueError(
+                "pipeline_stages subsumes shard_optim_state: optimizer "
+                "state is already stored per stage (and 1/N over the "
+                "data axis under shard_weight_update) — drop "
+                "shard_optim_state")
+        if self.wire_codec is not None:
+            raise ValueError(
+                "pipeline_stages composes with the implicit sharded "
+                "update only (wire_codec=None) — the explicit "
+                "compressed-wire step is a whole-step shard_map that "
+                "cannot nest the pipeline schedule")
+        if self._pad_stage is not None:
+            raise ValueError(
+                "pipeline_stages does not compose with "
+                "pad_partial_batches — pad in the dataset pipeline")
+        if self.expert_parallel:
+            raise ValueError(
+                "pipeline_stages + expert_parallel in one stack is not "
+                "supported yet: MoE layers carry per-step state the "
+                "pipeline's stateless-block contract refuses")
+        from bigdl_tpu.parallel.pipeline import PipelineParallel
+        pp = PipelineParallel(
+            mesh, self.model, self.criterion, self.optim_method,
+            n_stages=self.pipeline_stages,
+            num_microbatches=self.grad_accumulation,
+            schedule=self.pipeline_schedule,
+            virtual_stages=self.pipeline_virtual_stages,
+            data_axis="data", remat_policy=self.remat_policy,
+            sharded_update=(self.shard_weight_update
+                            or self.wire_codec is not None),
+            bucket_mb=self.bucket_mb)
+        from bigdl_tpu.parallel.pipeline import pipeline_schedule_stats
+        st = pipeline_schedule_stats(
+            pp.m, pp.s, pp.schedule, virtual_stages=pp.v)
+        logger.info(
+            "pipeline: %d stages x %d virtual, %s schedule, M=%d "
+            "microbatches — modeled bubble %.3f, stash %d microbatches",
+            pp.s, pp.v, pp.schedule, pp.m, st["bubble_fraction"],
+            st["peak_stash_microbatches"])
+        return pp
+
+    def _publish_expert_telemetry(self, mstate) -> None:
+        """Epoch-boundary MoE telemetry publish: ONE batched
+        ``jax.device_get`` over every MoE layer's state leaves — the
+        loop never pays a per-step sync for it."""
+        if not self.expert_parallel:
+            return
+        from bigdl_tpu.parallel.expert import publish_moe_metrics
+        try:
+            stats = publish_moe_metrics(mstate)
+        except Exception as e:    # telemetry must never break training
+            logger.debug("moe telemetry publish failed: %s", e)
+            return
+        if stats and logger.isEnabledFor(logging.INFO):
+            for layer, vals in stats.items():
+                logger.info(
+                    "moe[%s]: dropped ranks %.1f%%, tokens %.1f%%, "
+                    "overflow %.0f, imbalance %.2f", layer,
+                    100 * vals.get("moe_dropped_rank_frac", 0.0),
+                    100 * vals.get("moe_dropped_token_frac", 0.0),
+                    vals.get("moe_overflow_tokens", 0.0),
+                    vals.get("moe_load_imbalance", 0.0))
+
     def _init_sharded_update(self, mesh, params):
         """Validate + build the ShardedWeightUpdate mechanics (None when
         the feature is off). Raises on configurations whose layouts
@@ -208,9 +285,24 @@ class DistriOptimizer(Optimizer):
         driver_state = {"epoch": int(self.state.get("epoch", 1)),
                         "neval": int(self.state.get("neval", 1)),
                         "is_epoch_end": False, "loss": float("inf")}
+        if self.expert_parallel and \
+                self.expert_parallel not in mesh.axis_names:
+            raise ValueError(
+                f"expert_parallel={self.expert_parallel!r} needs that "
+                f"mesh axis — build the mesh with Engine.init(axes="
+                f"{{'data': N, {self.expert_parallel!r}: E}}) (mesh "
+                f"has {mesh.axis_names})")
+        if self.expert_parallel and self.wire_codec is not None:
+            raise ValueError(
+                "expert_parallel does not compose with an explicit "
+                "wire codec: the per-shard compressed step cannot nest "
+                "the MoE dispatch's own shard_map — use "
+                "wire_codec=None")
         opt_state, rng, count_this_epoch, batches_to_skip = \
             self._resume(optim, params)
-        su = self._init_sharded_update(mesh, params)
+        pp = self._init_pipeline(mesh)
+        su = None if pp is not None \
+            else self._init_sharded_update(mesh, params)
         if su is None and isinstance(opt_state, dict) \
                 and "ef_residual" in opt_state:
             # resuming a compressed-collective checkpoint into a run
@@ -221,7 +313,10 @@ class DistriOptimizer(Optimizer):
                         "(sharded update with int8 codec not active)")
 
         repl = replicated(mesh)
-        batch_shard = data_sharding(mesh)
+        # a pure-pipeline mesh (axes={'pipe': S}) has no data axis: the
+        # batch replicates and every stage sees the full microbatches
+        batch_shard = (repl if "data" not in mesh.axis_names
+                       else data_sharding(mesh))
         label_shard = batch_shard
         sp_axis, sp_size = None, 1
         if self.sequence_parallel:
@@ -264,7 +359,17 @@ class DistriOptimizer(Optimizer):
                 sharding_for_tree_like
             opt_shard = sharding_for_tree_like(opt_state, params,
                                                tp_tree, repl)
-        if su is not None:
+        if pp is not None:
+            # pipeline owns the layouts: device-major stacked layer
+            # params over 'pipe', optimizer state in the matching
+            # stacked (or per-stage bucket-slice) form
+            # (parallel/pipeline.py)
+            mstate = jax.device_put(mstate, repl)
+            params = pp.import_params(params)
+            opt_state = pp.import_opt_state(opt_state)
+            param_shard = pp.params_sharding()
+            opt_shard = pp.opt_state_sharding(opt_state)
+        elif su is not None:
             # sharded update owns both layouts: flat bucket slices for
             # optimizer state, and (explicit codecs) master slices for
             # params (optim/sharded_update.py)
@@ -291,7 +396,17 @@ class DistriOptimizer(Optimizer):
         from bigdl_tpu.optim.remat import remat_forward
         fwd = remat_forward(model, self.remat_policy)
 
-        if su is not None and su.codec is not None:
+        if pp is not None:
+            # combined forward/backward schedule in ONE compiled step:
+            # remat applies per chunk inside the schedule's backward
+            # recompute, the data-axis reduction (or the per-stage
+            # bucketed reduce-scatter under shard_weight_update) and
+            # the optimizer update fire once per accumulated step
+            # (parallel/pipeline.py)
+            train_step = pp.make_train_step(
+                grad_clip=self.grad_clip,
+                input_transform=self.input_transform)
+        elif su is not None and su.codec is not None:
             # explicit construction: the whole step runs per-shard under
             # shard_map — local forward/backward (scanned k microbatches
             # at a time under grad accumulation, with the bucketed
@@ -333,7 +448,8 @@ class DistriOptimizer(Optimizer):
                 grad_clip=self.grad_clip,
                 update_fn=(su.apply_update if su is not None
                            else optim.update),
-                num_microbatches=self.grad_accumulation)
+                num_microbatches=self.grad_accumulation,
+                aux_loss=self._aux_loss_fn())
 
         # label_shard is None under sequence_parallel (rank-derived at
         # placement, _shard_batch); jit then inherits the arg sharding
@@ -363,9 +479,10 @@ class DistriOptimizer(Optimizer):
             out, _ = model.apply(params, mstate, data, training=False)
             return out
 
-        # sharded update: evaluation/checkpoint see the gathered f32
-        # params tree (masters), so eval shardings are replicated
-        eval_param_shard = repl if su is not None else param_shard
+        # sharded update / pipeline: evaluation/checkpoint see the
+        # gathered params tree, so eval shardings are replicated
+        eval_param_shard = (repl if su is not None or pp is not None
+                            else param_shard)
         if jax.process_count() > 1:
             # multi-host in-training validation: per-process shards can't
             # be device_put onto the global mesh (round-5 review finding:
@@ -508,6 +625,9 @@ class DistriOptimizer(Optimizer):
                     epoch_start_host_rng = self._host_rng_snapshot()
                     pipeline = self._open_train_pipeline(
                         place, records_scale=jax.process_count())
+                    # MoE dispatch telemetry -> registry, once per
+                    # epoch (one batched readback, never per-step)
+                    self._publish_expert_telemetry(mstate)
                 fire_val, fire_ckpt = self._fires(driver_state)
                 ptree, opt_export = params, opt_state
                 if fire_val or fire_ckpt:
@@ -523,6 +643,10 @@ class DistriOptimizer(Optimizer):
                         ptree = su.gather_params(params)
                         if fire_ckpt:
                             opt_export = su.export_opt_state(opt_state)
+                    elif pp is not None:
+                        ptree = pp.gather_params(params)
+                        if fire_ckpt:
+                            opt_export = pp.export_opt_state(opt_state)
                     model.sync(ptree, mstate)
                 self._validate(eval_fn, ptree, mstate, driver_state,
                                fire=fire_val)
@@ -534,7 +658,11 @@ class DistriOptimizer(Optimizer):
 
         self._drain_pending(pending, driver_state, "training end")
         self._stop_profiler()
-        model.sync(su.gather_params(params) if su is not None else params,
-                   mstate)
+        self._publish_expert_telemetry(mstate)
+        if su is not None:
+            params = su.gather_params(params)
+        elif pp is not None:
+            params = pp.gather_params(params)
+        model.sync(params, mstate)
         model.evaluate()
         return model

@@ -106,7 +106,12 @@ class Optimizer:
 
     def __init__(self, model, dataset, criterion, batch_size=None, *,
                  remat_policy: str | None = None,
-                 grad_accumulation: int = 1, **kw):
+                 grad_accumulation: int = 1,
+                 pipeline_stages: int = 1,
+                 pipeline_schedule: str = "1f1b",
+                 pipeline_virtual_stages: int = 1,
+                 expert_parallel: bool | str = False,
+                 expert_aux_weight: float = 1e-2, **kw):
         from bigdl_tpu.dataset.transformer import SampleToBatch
         from bigdl_tpu.optim.remat import check_remat_policy
         self.model = model
@@ -140,6 +145,24 @@ class Optimizer:
         self.remat_policy = check_remat_policy(remat_policy)
         self.grad_accumulation = self._check_grad_accumulation(
             grad_accumulation)
+        # pipeline + expert parallelism (parallel/pipeline.py,
+        # parallel/expert.py, docs/PERFORMANCE.md): stage count/schedule
+        # for Sequential stacks over a 'pipe' mesh axis, and the MoE
+        # aux-loss/telemetry wiring for models carrying MoE layers over
+        # an 'expert' mesh axis. All of it is AOT-cache key material.
+        self.pipeline_stages = 1
+        self.pipeline_schedule = "1f1b"
+        self.pipeline_virtual_stages = 1
+        if pipeline_stages != 1 or pipeline_virtual_stages != 1 \
+                or pipeline_schedule != "1f1b":
+            self.set_pipeline(pipeline_stages,
+                              schedule=pipeline_schedule,
+                              virtual_stages=pipeline_virtual_stages)
+        self.expert_parallel = None
+        self.expert_aux_weight = float(expert_aux_weight)
+        if expert_parallel:
+            self.set_expert_parallel(expert_parallel,
+                                     aux_weight=expert_aux_weight)
         self.train_summary = None
         self.val_summary = None
         # async dispatch: how many steps may be in flight before the loop
@@ -333,6 +356,67 @@ class Optimizer:
             num_microbatches)
         return self
 
+    def set_pipeline(self, num_stages: int, *, schedule: str = "1f1b",
+                     virtual_stages: int = 1):
+        """Partition a ``Sequential`` model's top-level blocks into
+        ``num_stages`` pipeline stages over the mesh ``pipe`` axis and
+        compile ONE train step that scans the combined forward/backward
+        schedule (``"gpipe"`` / ``"1f1b"`` / ``"interleaved_1f1b"``;
+        parallel/pipeline.py, docs/PERFORMANCE.md).
+        ``set_grad_accumulation(M)`` sets the microbatch count the
+        schedule streams — the optimizer update still fires exactly once
+        per step, and the trained trajectory matches the non-pipelined
+        accumulated step (tests/test_pipeline_train.py pins it
+        bit-identical on the pure-pipe mesh). ``virtual_stages > 1``
+        (interleaved schedule only) assigns each device that many
+        round-robin chunks, shrinking the bubble fraction from
+        (S-1)/(M+S-1) to (S-1)/(v·M+S-1). Distributed path only: the
+        local optimizer has no mesh to pipeline over. The knobs key the
+        AOT executable cache. Returns self."""
+        from bigdl_tpu.parallel.pipeline import check_pipeline_schedule
+        if int(num_stages) < 1:
+            raise ValueError(
+                f"pipeline_stages must be >= 1, got {num_stages}")
+        if int(virtual_stages) < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {virtual_stages}")
+        self.pipeline_stages = int(num_stages)
+        self.pipeline_schedule = check_pipeline_schedule(schedule)
+        self.pipeline_virtual_stages = int(virtual_stages)
+        return self
+
+    def set_expert_parallel(self, axis: bool | str = True, *,
+                            aux_weight: float = 1e-2):
+        """Wire the model's MoE layers (parallel/expert.py ``MoE``) into
+        the training objective: the load-balancing aux loss the layers
+        stash in module state joins the criterion with weight
+        ``aux_weight``, and the dispatch telemetry (token drops,
+        overflow, load imbalance) is published to the metric registry at
+        epoch boundaries — one batched readback per epoch, never a
+        per-step sync. ``axis`` names the mesh axis experts shard over
+        (True = ``"expert"``); the mesh must carry it. Keys the AOT
+        executable cache. Returns self."""
+        if aux_weight < 0:
+            raise ValueError(
+                f"aux_weight must be >= 0, got {aux_weight}")
+        self.expert_parallel = ("expert" if axis is True else axis) \
+            if axis else None
+        self.expert_aux_weight = float(aux_weight)
+        return self
+
+    def _aux_loss_fn(self):
+        """The aux-loss hook ``make_train_step`` folds into the
+        objective (None when expert parallelism is off)."""
+        if not self.expert_parallel:
+            return None
+        from bigdl_tpu.parallel.expert import moe_aux_total
+        w = self.expert_aux_weight
+
+        def aux(new_mstate):
+            return w * moe_aux_total(new_mstate)
+
+        return aux
+
     def set_sharded_update(self, enabled: bool = True, *,
                           wire_codec=None, bucket_mb: float | None = None):
         """Configure the fully cross-replica-sharded weight update
@@ -421,7 +505,14 @@ class Optimizer:
                 # identical shapes — they must miss the cache; k=1 and
                 # policy "none" ARE the plain step (same key as a run
                 # that never configured them)
-                self.remat_policy, self.grad_accumulation)
+                self.remat_policy, self.grad_accumulation,
+                # pipeline schedule/stages and the MoE aux wiring also
+                # change the program at identical shapes
+                # (tests/test_pipeline_train.py pins the miss)
+                self.pipeline_stages, self.pipeline_schedule,
+                self.pipeline_virtual_stages, self.expert_parallel,
+                self.expert_aux_weight if self.expert_parallel
+                else None)
 
     def set_metrics_server(self, port: int = 0, host: str = "127.0.0.1",
                            *, liveness_deadline: float = 600.0):
@@ -884,6 +975,12 @@ class LocalOptimizer(Optimizer):
     def _optimize_impl(self):
         model, criterion, optim = self.model, self.criterion, \
             self.optim_method
+        if self.pipeline_stages > 1:
+            raise ValueError(
+                "pipeline_stages needs a device mesh to shard stages "
+                "over — construct the optimizer with mesh= (or a "
+                "sharded dataset) so the distributed path runs, with a "
+                "'pipe' axis of that size")
         if self.shard_weight_update or self.wire_codec is not None:
             logger.info(
                 "sharded update / wire codec configured, but the local "
@@ -919,7 +1016,8 @@ class LocalOptimizer(Optimizer):
             criterion=criterion, masked=masked,
             input_transform=self.input_transform,
             grad_clip=self.grad_clip, update_fn=optim.update,
-            num_microbatches=self.grad_accumulation)
+            num_microbatches=self.grad_accumulation,
+            aux_loss=self._aux_loss_fn())
 
         # explicit lower -> compile -> cache step construction
         # (tuning/aot_cache.py): executables are built per batch

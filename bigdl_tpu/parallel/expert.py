@@ -12,6 +12,22 @@ practice).
 
 Functional and differentiable end-to-end: the gate receives gradients
 through the combine weights, experts through their tokens.
+
+Production wiring (ISSUE 11): :class:`MoE` is the layer a ``Sequential``
+model drops in (built-in two-layer FFN experts, learned gate, the
+load-balancing aux loss and the dispatch telemetry carried in module
+STATE so they ride the train step without extra host syncs), and
+``DistriOptimizer.set_expert_parallel()`` threads the aux loss into the
+training objective and publishes the drop/overflow/imbalance counters to
+the metric registry at epoch boundaries (one batched ``jax.device_get``
+per epoch — never a per-step sync; see docs/PERFORMANCE.md).
+
+Combine-weight semantics after capacity drops: the k gate probabilities
+renormalize over the KEPT ranks only. A dropped second choice used to
+leave the first choice's weight at p1/(p1+p2) — every affected token's
+output was silently scaled down by the dropped rank's share, biasing the
+combine toward underweighted outputs (ISSUE 11 satellite; pinned in
+tests/test_expert_parallel.py).
 """
 from __future__ import annotations
 
@@ -22,13 +38,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 from bigdl_tpu.parallel.collective import shard_map
 from bigdl_tpu.parallel.engine import get_mesh
 
-__all__ = ["moe_apply"]
+__all__ = ["moe_apply", "MoE", "moe_aux_total", "moe_state_stats",
+           "publish_moe_metrics"]
+
+#: module-state keys the MoE layer maintains (floats — they survive the
+#: gradient-accumulation scan's inexact-leaf averaging)
+MOE_STATE_KEYS = ("moe_aux", "moe_dropped_rank_frac",
+                  "moe_dropped_token_frac", "moe_overflow_tokens",
+                  "moe_load_imbalance")
 
 
 def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
               capacity_factor: float = 1.25, axis: str = "model",
               mesh: Mesh | None = None, k: int = 1,
-              renormalize: bool = True):
+              renormalize: bool = True, with_stats: bool = False):
     """Top-k mixture of experts over mesh ``axis`` (one expert per shard).
 
     - ``expert_apply(expert_params, tokens) -> tokens``: one expert's pure
@@ -41,12 +64,21 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
       (GShard-style). Ranks claim capacity slots in order (every token's
       first choice before any second choice); a rank whose expert queue
       is full is dropped for that rank only. ``renormalize`` divides the
-      k gate probs by their sum (GShard practice; ignored at k=1).
+      gate probs of the ranks that were actually KEPT by their sum
+      (post-drop renormalization — a dropped rank's share is
+      redistributed to the surviving ranks instead of silently shrinking
+      the output; ignored at k=1).
 
-    Returns (y, aux_loss) — y shaped like x (tokens with EVERY rank
+    Returns ``(y, aux_loss)`` — y shaped like x (tokens with EVERY rank
     dropped pass through unchanged); aux_loss is the standard
     load-balancing loss over first-choice assignments
-    (E * sum_e fraction_e * prob_e).
+    (E * sum_e fraction_e * prob_e). ``with_stats=True`` returns
+    ``(y, aux_loss, stats)`` where ``stats`` holds the dispatch
+    telemetry, reduced across shards: ``dropped_rank_frac`` (rank
+    assignments lost to capacity), ``dropped_token_frac`` (tokens that
+    lost EVERY rank and passed through), ``overflow_tokens`` (total
+    demand beyond capacity), and ``load_imbalance`` (max over experts of
+    first-choice fraction x E; 1.0 = perfectly balanced).
     """
     mesh = mesh or get_mesh()
     e = mesh.shape[axis]
@@ -71,8 +103,6 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
         logits = (xb.astype(f32) @ gw.astype(f32))            # (T, E)
         probs = jax.nn.softmax(logits, axis=-1)
         top_p, top = jax.lax.top_k(probs, k)                  # (T, k)
-        if renormalize and k > 1:
-            top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
         # rank-ordered capacity assignment: rank r's queue positions
         # start where ranks < r left each expert's occupancy
@@ -89,6 +119,16 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
             occupied = occupied + jnp.sum(
                 jnp.where(in_cap, 1.0, 0.0), axis=0)
             ranks.append((onehot, kept, slot))
+
+        if renormalize and k > 1:
+            # post-drop renormalization: only the ranks that actually
+            # made it into capacity share the combine weight (ISSUE 11
+            # satellite — dividing by the pre-drop sum left a dropped
+            # second choice's share subtracted from the output)
+            kept_w = jnp.stack([kept for _, kept, _ in ranks],
+                               axis=1).astype(f32)            # (T, k)
+            denom = jnp.sum(top_p * kept_w, axis=-1, keepdims=True)
+            top_p = top_p / jnp.maximum(denom, 1e-9)
 
         # dispatch tensor (E, C, d): rank r of token t -> slot
         # (top[t, r], slot_r[t]); ranks target distinct slots so the
@@ -129,12 +169,182 @@ def moe_apply(expert_apply, stacked_expert_params, x, gate_w, *,
         mean_p = jnp.mean(probs, axis=0)
         aux = jnp.sum(frac * mean_p) * e
         aux = jax.lax.pmean(aux, axis)
-        return y, aux
+
+        # dispatch telemetry, reduced across shards (stop_gradient —
+        # observational, never part of the objective)
+        kept_total = sum(jnp.sum(kept.astype(f32))
+                         for _, kept, _ in ranks)
+        demand = sum(jnp.sum(oh, axis=0) for oh, _, _ in ranks)  # (E,)
+        demand = jax.lax.psum(demand, axis)
+        n_tok = jax.lax.psum(jnp.asarray(float(t_local), f32), axis)
+        stats = {
+            "dropped_rank_frac":
+                1.0 - jax.lax.psum(kept_total, axis) / (n_tok * k),
+            "dropped_token_frac":
+                jax.lax.psum(jnp.sum(1.0 - kept_any.astype(f32)),
+                             axis) / n_tok,
+            "overflow_tokens":
+                jnp.sum(jnp.maximum(demand - cap * e, 0.0)),
+            "load_imbalance":
+                jnp.max(jax.lax.pmean(frac, axis)) * e,
+        }
+        stats = jax.tree.map(jax.lax.stop_gradient, stats)
+        return y, aux, stats
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_expert_params)
-    y, aux = shard_map(
+    y, aux, stats = shard_map(
         body, mesh=mesh,
         in_specs=(pspec, P(axis), P()),
-        out_specs=(P(axis), P()),
+        out_specs=(P(axis), P(), {k_: P() for k_ in
+                                  ("dropped_rank_frac",
+                                   "dropped_token_frac",
+                                   "overflow_tokens",
+                                   "load_imbalance")}),
         check_rep=False)(stacked_expert_params, x, gate_w)
+    if with_stats:
+        return y, aux, stats
     return y, aux
+
+
+from bigdl_tpu.nn.module import Module as _Module  # noqa: E402
+
+
+class MoE(_Module):
+    """Mixture-of-experts layer for ``Sequential`` models: built-in
+    two-layer tanh FFN experts (``d -> hidden -> d``), a learned gate,
+    top-k expert-parallel dispatch over the given mesh axis.
+
+    The load-balancing aux loss and the dispatch telemetry ride the
+    module STATE (``moe_aux`` etc.) — ``set_expert_parallel()`` on the
+    optimizer adds the aux term to the training objective and publishes
+    the telemetry to the metric registry at epoch boundaries. The state
+    leaves are floats, so the gradient-accumulation scan's
+    inexact-leaf averaging applies to them like any batch statistic.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int, *,
+                 k: int = 1, capacity_factor: float = 1.25,
+                 axis: str = "expert", renormalize: bool = True,
+                 mesh: Mesh | None = None):
+        super().__init__()
+        self.d_model = int(d_model)
+        self.d_hidden = int(d_hidden)
+        self.num_experts = int(num_experts)
+        self.k = int(k)
+        self.capacity_factor = float(capacity_factor)
+        self.axis = axis
+        self.renormalize = bool(renormalize)
+        self._mesh = mesh
+
+    def init(self, rng):
+        import numpy as np
+        kg, k1, k2 = jax.random.split(rng, 3)
+        e, d, h = self.num_experts, self.d_model, self.d_hidden
+        return {
+            "gate": (jax.random.normal(kg, (d, e), jnp.float32)
+                     / np.sqrt(d)),
+            "experts": {
+                "w1": (jax.random.normal(k1, (e, d, h), jnp.float32)
+                       / np.sqrt(d)),
+                "b1": jnp.zeros((e, h), jnp.float32),
+                "w2": (jax.random.normal(k2, (e, h, d), jnp.float32)
+                       / np.sqrt(h)),
+                "b2": jnp.zeros((e, d), jnp.float32),
+            },
+        }
+
+    def init_state(self):
+        return {key: jnp.zeros((), jnp.float32)
+                for key in MOE_STATE_KEYS}
+
+    @staticmethod
+    def _expert_apply(p, tokens):
+        h = jnp.tanh(tokens @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        d = x.shape[-1]
+        if d != self.d_model:
+            raise ValueError(f"MoE built for d_model={self.d_model}, "
+                             f"got feature dim {d}")
+        tokens = x.reshape(-1, d)
+        y, aux, stats = moe_apply(
+            self._expert_apply, params["experts"], tokens,
+            params["gate"], k=self.k,
+            capacity_factor=self.capacity_factor, axis=self.axis,
+            mesh=self._mesh or get_mesh(),
+            renormalize=self.renormalize, with_stats=True)
+        new_state = {"moe_aux": aux}
+        for key in MOE_STATE_KEYS:
+            short = key[len("moe_"):]
+            if short in stats:
+                new_state[key] = stats[short].astype(jnp.float32)
+        return y.reshape(x.shape), new_state
+
+    def __repr__(self):
+        return (f"MoE(d{self.d_model}x{self.d_hidden}, "
+                f"E={self.num_experts}, k={self.k}, "
+                f"cf={self.capacity_factor}, axis={self.axis!r})")
+
+
+def moe_aux_total(mstate):
+    """Sum of every MoE layer's load-balancing aux loss in a module
+    state tree (traced — this is the term ``set_expert_parallel`` folds
+    into the training objective; gradients flow to the gates through
+    it). Zero when the model carries no MoE layers."""
+    total = jnp.zeros((), jnp.float32)
+
+    def walk(tree):
+        nonlocal total
+        if isinstance(tree, dict):
+            if "moe_aux" in tree:
+                total = total + tree["moe_aux"]
+                return
+            for sub in tree.values():
+                walk(sub)
+
+    walk(mstate)
+    return total
+
+
+def moe_state_stats(mstate) -> dict:
+    """Walk a module-state tree for MoE layer states and return
+    ``{path: {stat: device array}}`` — one ``jax.device_get`` away from
+    host values (the caller batches the readback)."""
+    found = {}
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            if "moe_aux" in tree:
+                found["/".join(path) or "moe"] = {
+                    key: tree[key] for key in MOE_STATE_KEYS
+                    if key in tree}
+                return
+            for key, sub in tree.items():
+                walk(sub, path + [str(key)])
+
+    walk(mstate, [])
+    return found
+
+
+def publish_moe_metrics(mstate, registry=None) -> dict:
+    """Publish every MoE layer's dispatch telemetry from a module-state
+    tree to the metric registry (gauges labeled by layer path; the
+    ``moe_dropped_tokens_total``-style exposition names
+    docs/OBSERVABILITY.md documents). ONE batched ``jax.device_get`` for
+    all layers — call at epoch boundaries or drain points, never
+    per step. Returns ``{layer: {stat: float}}``."""
+    if registry is None:
+        from bigdl_tpu.observability.registry import default_registry
+        registry = default_registry()
+    staged = moe_state_stats(mstate)
+    if not staged:
+        return {}
+    host = jax.device_get(staged)
+    for layer, stats in host.items():
+        for key, val in stats.items():
+            registry.gauge(
+                key, "MoE dispatch telemetry (parallel/expert.py)",
+                labelnames=("layer",)).set(float(val), layer=layer)
+    return {layer: {key: float(val) for key, val in stats.items()}
+            for layer, stats in host.items()}

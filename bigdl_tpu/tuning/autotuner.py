@@ -40,7 +40,8 @@ __all__ = ["tune", "TuneResult", "Measurement", "VMEM_BUDGET_BYTES",
            "maxpool_candidates", "bucket_mb_candidates",
            "batch_geometry_candidates", "tile_divisors",
            "paged_attention_candidates", "paged_attention_est_vmem",
-           "step_memory_candidates", "step_memory_est_hbm"]
+           "step_memory_candidates", "step_memory_est_hbm",
+           "pipeline_schedule_candidates", "pipeline_est_hbm"]
 
 logger = logging.getLogger("bigdl_tpu.tuning")
 
@@ -331,6 +332,68 @@ def step_memory_est_hbm(residual_bytes_by_policy: dict,
         rb = residual_bytes_by_policy[c["remat_policy"]]
         return int(persistent_bytes + rb // max(int(
             c.get("num_microbatches", 1)), 1))
+    return est
+
+
+def pipeline_schedule_candidates(batch: int, n_layers: int,
+                                 stage_counts=(2, 4), *,
+                                 max_microbatches: int = 16,
+                                 max_virtual: int = 4) -> list[dict]:
+    """``(schedule, num_microbatches, stages, virtual_stages)`` grid for
+    the pipelined train step (parallel/pipeline.py): every power-of-two
+    microbatch count dividing ``batch`` crossed with the stage counts
+    that divide the layer stack, gpipe/1f1b at v=1 plus interleaved
+    variants while the chunking stays legal (layers divide S*v,
+    microbatches divide S). The measured ``tune()`` over these picks the
+    schedule with the smallest real step time; the static estimator
+    (:func:`pipeline_est_hbm`) prunes configurations whose activation
+    stash cannot fit before anything compiles."""
+    out = []
+    ms, k = [], 1
+    while k <= min(int(max_microbatches), int(batch)):
+        if batch % k == 0:
+            ms.append(k)
+        k *= 2
+    for s in stage_counts:
+        s = int(s)
+        if s < 1 or n_layers % s:
+            continue
+        for m in ms:
+            for sched in ("gpipe", "1f1b"):
+                out.append({"schedule": sched, "num_microbatches": m,
+                            "stages": s, "virtual_stages": 1})
+            v = 2
+            while v <= int(max_virtual) and n_layers % (s * v) == 0:
+                if m % s == 0:
+                    out.append({"schedule": "interleaved_1f1b",
+                                "num_microbatches": m, "stages": s,
+                                "virtual_stages": v})
+                v *= 2
+    return out
+
+
+def pipeline_est_hbm(act_bytes_full_batch: int,
+                     persistent_bytes: int = 0):
+    """Static per-stage HBM estimator for
+    :func:`pipeline_schedule_candidates` configs, built on the existing
+    per-stage residual model: the schedule's EXACT activation-stash
+    bound (``pipeline_schedule_stats`` — M microbatches for gpipe, ~S
+    for 1f1b) times the per-microbatch activation bytes
+    (``act_bytes_full_batch`` / M — the k=1 ``saved_residual_bytes``
+    term scaled the same way ``step_memory_est_hbm`` scales it), plus
+    the per-stage share of the persistent bytes. Use as ``est_vmem=``
+    with an HBM budget, or as ``est_cost=`` to order candidates
+    memory-first."""
+    def est(c: dict) -> int:
+        from bigdl_tpu.parallel.pipeline import pipeline_schedule_stats
+        m = max(int(c.get("num_microbatches", 1)), 1)
+        s = max(int(c.get("stages", 1)), 1)
+        st = pipeline_schedule_stats(
+            m, s, c.get("schedule", "1f1b"),
+            virtual_stages=int(c.get("virtual_stages", 1)))
+        per_mb = act_bytes_full_batch // m
+        return int(persistent_bytes // s
+                   + st["peak_stash_microbatches"] * per_mb)
     return est
 
 

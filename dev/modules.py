@@ -23,6 +23,7 @@ MODULES = {
     "parallel": ["tests/test_distributed.py", "tests/test_multihost.py",
                  "tests/test_tensor_parallel.py",
                  "tests/test_pipeline_parallel.py",
+                 "tests/test_pipeline_train.py",
                  "tests/test_expert_parallel.py",
                  "tests/test_sequence_parallel.py",
                  "tests/test_flash_attention.py"],

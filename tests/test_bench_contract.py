@@ -955,3 +955,90 @@ def test_serve_metrics_closes_on_probe_failure(monkeypatch, capsys):
         bench.main(["--serve-metrics", "0"])
     assert ei.value.code == 3
     assert bench._metrics_server is None
+
+
+class TestPipelineBubbleRow:
+    """ISSUE 11: pipeline_bubble_fraction — measured schedule bubbles
+    from per-stage span timings vs the extended
+    pipeline_schedule_stats model, on the standard row/known/all
+    contract. Lower is better and the gate knows."""
+
+    FAKE = {"metric": "pipeline_bubble_fraction", "value": 0.158,
+            "unit": "measured interleaved-1F1B bubble fraction "
+                    "(fill-drain idle share; lower is better)",
+            "measured_gpipe": 0.273, "modeled_gpipe": 0.273,
+            "measured_1f1b": 0.273, "modeled_1f1b": 0.273,
+            "measured_interleaved_1f1b": 0.158,
+            "modeled_interleaved_1f1b": 0.158,
+            "n_stages": 4, "num_microbatches": 8, "virtual_stages": 2}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_pipeline_bubble",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "pipeline_bubble_fraction",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "pipeline_bubble_fraction"
+        assert lines[-1]["rows"][0]["value"] == 0.158
+        with open(out) as f:
+            assert "bench_pipeline_bubble_fraction 0.158" in f.read()
+
+    def test_row_in_all_and_gate_direction(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "pipeline_bubble_fraction" in \
+            [r["metric"] for r in agg["rows"]]
+        # a bubble REGRESSION (larger fraction) must fail the gate
+        assert "pipeline_bubble_fraction" in bench._GATE_LOWER_IS_BETTER
+
+    def test_gate_lower_is_better_semantics(self, tmp_path):
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"rows": {
+            "pipeline_bubble_fraction": {
+                "value": 0.158, "min_ratio": 0.8,
+                "direction": "lower"}}}))
+        ok_row = [{"metric": "pipeline_bubble_fraction",
+                   "value": 0.16}]
+        bad_row = [{"metric": "pipeline_bubble_fraction",
+                    "value": 0.5}]
+        _, ok = bench._gate_check(str(base), ok_row)
+        assert ok
+        _, ok = bench._gate_check(str(base), bad_row)
+        assert not ok
+
+    def test_real_measure_in_process_tiny_geometry(self):
+        """The acceptance bar, in-process at tiny geometry: measured
+        1F1B-family (interleaved) bubble STRICTLY below measured
+        GPipe's at the same (S, M), and each measurement within
+        tolerance of the extended model."""
+        from bigdl_tpu.parallel.pipeline import measure_pipeline_bubble
+        out = measure_pipeline_bubble(
+            n_stages=2, num_microbatches=4, virtual_stages=2,
+            d_model=16, mb_rows=4, layers_per_stage=2, reps=3)
+        sch = out["schedules"]
+        assert sch["interleaved_1f1b"]["measured_bubble_fraction"] < \
+            sch["gpipe"]["measured_bubble_fraction"]
+        for name, r in sch.items():
+            assert r["measured_bubble_fraction"] == pytest.approx(
+                r["modeled_bubble_fraction"], abs=0.1), name
+
+    @pytest.mark.slow
+    def test_real_row_subprocess(self):
+        """The REAL subprocess row at a reduced geometry: the emitted
+        row carries measured + modeled numbers for every schedule and
+        the acceptance inequality holds."""
+        row = bench.bench_pipeline_bubble(
+            n_stages=2, num_microbatches=4, virtual_stages=2, reps=3)
+        assert row["metric"] == "pipeline_bubble_fraction"
+        assert row["value"] == row["measured_interleaved_1f1b"]
+        assert row["measured_interleaved_1f1b"] < row["measured_gpipe"]
+        for name in ("gpipe", "1f1b", "interleaved_1f1b"):
+            assert row[f"measured_{name}"] == pytest.approx(
+                row[f"modeled_{name}"], abs=0.1)

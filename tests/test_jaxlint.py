@@ -651,3 +651,118 @@ class TestRunTestsRegistry:
     def test_names_are_stripped_before_lookup(self, capsys):
         assert self._main()(["--modules", " optm , "]) == 2
         assert "['optm']" in capsys.readouterr().out
+
+
+class TestPipelineMoEFixtures:
+    """ISSUE 11 satellite: pin the TPU-correctness contract of the 1F1B
+    combined-schedule scan body (parallel/pipeline.py) and the MoE
+    dispatch (parallel/expert.py) — no hidden host syncs inside the
+    tick scan (JX1), donation respected around the pipelined step
+    (JX3) — and that the SHIPPED modules are clean."""
+
+    def test_host_sync_inside_tick_body_fires_jx1(self):
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def pipelined(params, tables, acts):
+                def tick(carry, xs):
+                    acts, gacc = carry
+                    fm = int(jnp.take(xs, 0))   # per-tick readback
+                    acts = acts.at[fm].set(acts[fm] + 1)
+                    return (acts, gacc), None
+                out, _ = jax.lax.scan(tick, (acts, params), tables)
+                return out
+        """)
+        assert rules(out) == ["JX1"]
+
+    def test_1f1b_tick_body_shape_is_clean(self):
+        """The shape of the real executor tick: schedule-table gathers,
+        cond-gated fwd/bwd units with inner vjp, ppermute hops, tree
+        adds in donated carries — no host conversions anywhere."""
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def pipelined(chunk, params, tables, acts, key, ds):
+                stage = jax.lax.axis_index("pipe")
+
+                def tick(carry, xs):
+                    acts, gacc, fmsg = carry
+                    fc, fm = (jnp.take(row, stage) for row in xs)
+
+                    def do_fwd(_):
+                        x = jnp.where(fc == 0, ds[0], acts[0])
+                        return chunk(params, x)
+
+                    def no_fwd(_):
+                        return jnp.zeros_like(acts[0])
+
+                    y = jax.lax.cond(fc >= 0, do_fwd, no_fwd, None)
+
+                    def do_bwd(_):
+                        yy, vjp = jax.vjp(chunk, params, acts[0])
+                        return vjp(yy)[0]
+
+                    def no_bwd(_):
+                        return jax.tree.map(jnp.zeros_like, params)
+
+                    gp = jax.lax.cond(fc >= 0, do_bwd, no_bwd, None)
+                    gacc = jax.tree.map(jnp.add, gacc, gp)
+                    fmsg = jax.lax.ppermute(
+                        y, "pipe", [(0, 1), (1, 0)])
+                    return (acts, gacc, fmsg), None
+
+                (acts, gacc, _), _ = jax.lax.scan(
+                    tick, (acts, jax.tree.map(jnp.zeros_like, params),
+                           acts[0]), tables)
+                return gacc
+        """)
+        assert out == []
+
+    def test_moe_dispatch_body_is_clean(self):
+        """The MoE dispatch shape: top_k routing, capacity cumsum,
+        scatter-add dispatch, all_to_all hops, psum'd telemetry."""
+        out = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def dispatch(xb, gw, expert, cap, e):
+                probs = jax.nn.softmax(xb @ gw, axis=-1)
+                top_p, top = jax.lax.top_k(probs, 2)
+                onehot = jax.nn.one_hot(top[:, 0], e)
+                pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+                kept = jnp.any((pos < cap) & (onehot > 0), axis=-1)
+                disp = jnp.zeros((e, cap, xb.shape[1]), xb.dtype)
+                disp = disp.at[top[:, 0], 0].add(
+                    jnp.where(kept[:, None], xb, 0))
+                recv = jax.lax.all_to_all(disp, "expert", split_axis=0,
+                                          concat_axis=0, tiled=True)
+                y = expert(recv)
+                back = jax.lax.all_to_all(y, "expert", split_axis=0,
+                                          concat_axis=0, tiled=True)
+                dropped = jax.lax.psum(
+                    jnp.sum(1.0 - kept.astype(jnp.float32)), "expert")
+                return back, dropped
+        """)
+        assert out == []
+
+    def test_reading_donated_pipeline_state_fires_jx3(self):
+        out = lint("""
+            import jax
+
+            def train(step, params, opt_state, batches):
+                jit_step = jax.jit(step, donate_argnums=(0, 1))
+                for b in batches:
+                    new_p, new_o = jit_step(params, opt_state, b)
+                return params
+        """)
+        assert "JX3" in rules(out)
+
+    def test_shipped_pipeline_and_expert_modules_are_clean(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ("bigdl_tpu/parallel/pipeline.py",
+                    "bigdl_tpu/parallel/expert.py"):
+            path = os.path.join(repo, *rel.split("/"))
+            assert os.path.exists(path), path
+            assert jaxlint.analyze_file(path, repo) == [], rel

@@ -1,0 +1,502 @@
+"""1F1B pipeline training path (ISSUE 11 tentpole): the schedule model,
+the combined forward/backward step construction
+(parallel/pipeline.py PipelineParallel), and the DistriOptimizer wiring.
+
+The load-bearing pin: the pipelined trained trajectory is BIT-IDENTICAL
+to the non-pipelined ``set_grad_accumulation(M)`` step on a pure-pipe
+mesh (same microbatch split, same gradient-add order, same rng folds),
+and within float-reassociation tolerance (rtol 1e-6) once a data axis
+adds its cross-shard mean — the same FMA caveat the remat contract
+documents (docs/PERFORMANCE.md).
+
+Runtime budget: step-level pins run tier-1; full-optimizer-loop
+integration and the extra-schedule variants spawn multi-program compiles
+and are ``slow``-tiered (tier-1 runs ~700-750s of a hard 870s cap).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.optim.accumulation import make_train_step
+from bigdl_tpu.optim.sgd import SGD
+from bigdl_tpu.parallel.engine import Engine
+from bigdl_tpu.parallel.pipeline import (PipelineParallel,
+                                         partition_sequential,
+                                         pipeline_schedule_order,
+                                         pipeline_schedule_stats,
+                                         simulate_schedule)
+
+
+def build_model(n_blocks=4, d=8, seed=0):
+    m = nn.Sequential()
+    for _ in range(n_blocks):
+        m.add(nn.Sequential(nn.Linear(d, d), nn.Tanh()))
+    m.materialize(jax.random.PRNGKey(seed))
+    m.training()
+    return m
+
+
+def make_batch(batch=8, d=8, seed=0):
+    rs = np.random.default_rng(seed)
+    return (jnp.asarray(rs.standard_normal((batch, d))
+                        .astype(np.float32)),
+            jnp.asarray(rs.standard_normal((batch, d))
+                        .astype(np.float32)))
+
+
+def reference_step(model, criterion, optim, m):
+    """The non-pipelined comparator: ``set_grad_accumulation(M)``'s
+    exact step construction."""
+    return jax.jit(make_train_step(
+        fwd=model.apply, criterion=criterion, update_fn=optim.update,
+        num_microbatches=m))
+
+
+def pipeline_step(pp):
+    return jax.jit(
+        pp.make_train_step(),
+        in_shardings=(pp.params_sharding(), None, None, None, None,
+                      None, None),
+        out_shardings=(pp.params_sharding(), None, None, None))
+
+
+class TestScheduleModel:
+    """The extended pipeline_schedule_stats contract: closed-form
+    bubbles per schedule, exact stash bounds, unit coverage."""
+
+    @pytest.mark.parametrize("m,s", [(4, 2), (8, 4), (4, 4), (8, 2)])
+    def test_1f1b_bubble_equals_gpipe_formula(self, m, s):
+        """Non-interleaved 1F1B has GPipe's bubble — its win is the
+        stash (the schedule table in docs/PERFORMANCE.md)."""
+        g = pipeline_schedule_stats(m, s, "gpipe")
+        f = pipeline_schedule_stats(m, s, "1f1b")
+        assert g["bubble_fraction"] == pytest.approx((s - 1) / (m + s - 1))
+        assert f["bubble_fraction"] == pytest.approx(g["bubble_fraction"])
+
+    @pytest.mark.parametrize("m,s,v", [(4, 2, 2), (8, 4, 2), (8, 2, 4)])
+    def test_interleaved_bubble_strictly_below_gpipe(self, m, s, v):
+        g = pipeline_schedule_stats(m, s, "gpipe")
+        i = pipeline_schedule_stats(m, s, "interleaved_1f1b",
+                                    virtual_stages=v)
+        assert i["bubble_fraction"] == pytest.approx(
+            (s - 1) / (v * m + s - 1))
+        assert i["bubble_fraction"] < g["bubble_fraction"]
+
+    @pytest.mark.parametrize("m,s", [(8, 2), (8, 4), (16, 4)])
+    def test_1f1b_stash_bounded_by_stages_not_microbatches(self, m, s):
+        g = pipeline_schedule_stats(m, s, "gpipe")
+        f = pipeline_schedule_stats(m, s, "1f1b")
+        assert g["peak_stash_microbatches"] == m
+        assert f["peak_stash_microbatches"] <= s
+
+    def test_legacy_gpipe_fields_unchanged(self):
+        st = pipeline_schedule_stats(4, 4)
+        assert st["ticks"] == 7 and st["bubble_ticks"] == 3
+        assert st["bubble_fraction"] == pytest.approx(3 / 7)
+
+    @pytest.mark.parametrize("sched,v", [("gpipe", 1), ("1f1b", 1),
+                                         ("interleaved_1f1b", 2)])
+    def test_every_unit_scheduled_exactly_once(self, sched, v):
+        m, s = 4, 2
+        o = pipeline_schedule_order(m, s, sched, v)
+        units = [u for order in o.orders for u in order]
+        assert len(units) == len(set(units)) == 2 * s * v * m
+        want = {(k, g, mb) for k in "FB" for g in range(s * v)
+                for mb in range(m)}
+        assert set(units) == want
+        # the per-device orders place each chunk on its round-robin
+        # device
+        for d, order in enumerate(o.orders):
+            assert all(g % s == d for _, g, _ in order)
+
+    def test_measured_sim_is_duration_invariant(self):
+        """The bubble FRACTION is invariant to the fwd/bwd cost ratio —
+        what makes the measured receipt comparable to the unit-tick
+        model (docs/PERFORMANCE.md)."""
+        for sched, v in [("gpipe", 1), ("1f1b", 1),
+                         ("interleaved_1f1b", 2)]:
+            o = pipeline_schedule_order(8, 4, sched, v)
+            a = simulate_schedule(o, [1.0] * 4, [1.0] * 4)
+            b = simulate_schedule(o, [3.0] * 4, [7.0] * 4)
+            assert a["bubble_fraction"] == pytest.approx(
+                b["bubble_fraction"])
+            assert a["bubble_fraction"] == pytest.approx(
+                o.bubble_fraction)
+
+    def test_rejections(self):
+        with pytest.raises(ValueError, match="virtual_stages"):
+            pipeline_schedule_order(4, 2, "gpipe", 2)
+        with pytest.raises(ValueError, match="divide"):
+            pipeline_schedule_order(3, 2, "interleaved_1f1b", 2)
+        with pytest.raises(ValueError, match="unknown pipeline"):
+            pipeline_schedule_stats(4, 2, "zigzag")
+
+
+class TestStepParity:
+    """The acceptance pin: pipelined step == non-pipelined accumulated
+    step, bit-identical on the pure-pipe mesh."""
+
+    def _run_pair(self, schedule, v=1, steps=4, clip=None):
+        crit = nn.MSECriterion()
+        m_ref = build_model()
+        sgd_ref = SGD(learning_rate=0.1, momentum=0.9)
+        o_ref = dict(sgd_ref.init_state(m_ref.params))
+        ref = jax.jit(make_train_step(
+            fwd=m_ref.apply, criterion=crit, update_fn=sgd_ref.update,
+            num_microbatches=4, grad_clip=clip))
+
+        Engine.reset()
+        mesh = Engine.init(axes={"pipe": 2}, devices=jax.devices()[:2])
+        m_pp = build_model()
+        sgd_pp = SGD(learning_rate=0.1, momentum=0.9)
+        pp = PipelineParallel(mesh, m_pp, crit, sgd_pp, n_stages=2,
+                              num_microbatches=4, schedule=schedule,
+                              virtual_stages=v)
+        p_pp = pp.import_params(m_pp.params)
+        o_pp = pp.import_opt_state(sgd_pp.init_state(m_pp.params))
+        step = jax.jit(pp.make_train_step(grad_clip=clip))
+
+        p_ref, s_ref = m_ref.params, m_ref.state
+        rs = np.random.default_rng(0)
+        rng = jax.random.PRNGKey(7)
+        losses_ref, losses_pp = [], []
+        for _ in range(steps):
+            data, labels = (jnp.asarray(rs.standard_normal((8, 8))
+                                        .astype(np.float32))
+                            for _ in range(2))
+            rng, sk = jax.random.split(rng)
+            ep = jnp.asarray(1, jnp.int32)
+            p_ref, s_ref, o_ref, l_ref = ref(p_ref, s_ref, o_ref, sk,
+                                             data, labels, ep)
+            p_pp, _, o_pp, l_pp = step(p_pp, m_pp.state, o_pp, sk,
+                                       data, labels, ep)
+            losses_ref.append(float(l_ref))
+            losses_pp.append(float(l_pp))
+        pt = jax.device_get(pp.gather_params(p_pp))
+        pr = jax.device_get(p_ref)
+        Engine.reset()
+        return losses_ref, losses_pp, pr, pt
+
+    def test_1f1b_trajectory_bit_identical_to_accumulated(self):
+        losses_ref, losses_pp, pr, pt = self._run_pair("1f1b")
+        assert losses_ref == losses_pp
+        for a, b in zip(jax.tree.leaves(pr), jax.tree.leaves(pt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_interleaved_trajectory_matches(self):
+        losses_ref, losses_pp, pr, pt = self._run_pair(
+            "interleaved_1f1b", v=2)
+        np.testing.assert_allclose(losses_ref, losses_pp, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(pr), jax.tree.leaves(pt)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.slow
+    def test_gpipe_trajectory_matches(self):
+        """GPipe retires backwards in REVERSE microbatch order, so the
+        gradient adds re-associate — rtol, not bitwise."""
+        losses_ref, losses_pp, pr, pt = self._run_pair("gpipe", steps=2)
+        np.testing.assert_allclose(losses_ref, losses_pp, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(pr), jax.tree.leaves(pt)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.slow
+    def test_global_l2_clip_parity(self):
+        """The clip norm psums per-stage square sums over the pipe axis
+        — it must equal the whole-tree norm the comparator clips by."""
+        clip = {"l2_norm": 0.05, "min_value": None, "max_value": None}
+        losses_ref, losses_pp, pr, pt = self._run_pair("1f1b", steps=2,
+                                                       clip=clip)
+        assert losses_ref == losses_pp
+        for a, b in zip(jax.tree.leaves(pr), jax.tree.leaves(pt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_dropout_rng_folds_match(self):
+        """Per-(child, microbatch) rng folds mirror Sequential.apply
+        under fold_in(rng, mb) — dropout masks land identically."""
+        def build(seed=0):
+            m = nn.Sequential()
+            for _ in range(2):
+                m.add(nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.4),
+                                    nn.Tanh()))
+            m.materialize(jax.random.PRNGKey(seed))
+            m.training()
+            return m
+
+        crit = nn.MSECriterion()
+        m_ref = build()
+        sgd_ref = SGD(learning_rate=0.1)
+        o_ref = dict(sgd_ref.init_state(m_ref.params))
+        ref = jax.jit(make_train_step(
+            fwd=m_ref.apply, criterion=crit, update_fn=sgd_ref.update,
+            num_microbatches=4))
+        Engine.reset()
+        mesh = Engine.init(axes={"pipe": 2}, devices=jax.devices()[:2])
+        m_pp = build()
+        sgd_pp = SGD(learning_rate=0.1)
+        pp = PipelineParallel(mesh, m_pp, crit, sgd_pp, n_stages=2,
+                              num_microbatches=4)
+        p_pp = pp.import_params(m_pp.params)
+        o_pp = pp.import_opt_state(sgd_pp.init_state(m_pp.params))
+        step = jax.jit(pp.make_train_step())
+        data, labels = make_batch()
+        sk = jax.random.PRNGKey(3)
+        ep = jnp.asarray(1, jnp.int32)
+        _, _, _, l_ref = ref(m_ref.params, m_ref.state, o_ref, sk,
+                             data, labels, ep)
+        _, _, _, l_pp = step(p_pp, m_pp.state, o_pp, sk, data, labels,
+                             ep)
+        assert float(l_ref) == float(l_pp)
+        Engine.reset()
+
+
+class TestShardedUpdateComposition:
+    """Acceptance: pipeline x sharded update x remat x accumulation in
+    ONE config — and the optimizer state exports back params-shaped."""
+
+    def test_composed_step_matches_plain_accumulated(self):
+        crit = nn.MSECriterion()
+        m_ref = build_model()
+        sgd_ref = SGD(learning_rate=0.1, momentum=0.9)
+        o_ref = dict(sgd_ref.init_state(m_ref.params))
+        ref = jax.jit(make_train_step(
+            fwd=m_ref.apply, criterion=crit, update_fn=sgd_ref.update,
+            num_microbatches=4))
+
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 2, "pipe": 2},
+                           devices=jax.devices()[:4])
+        m_pp = build_model()
+        sgd_pp = SGD(learning_rate=0.1, momentum=0.9)
+        pp = PipelineParallel(
+            mesh, m_pp, crit, sgd_pp, n_stages=2, num_microbatches=4,
+            schedule="1f1b", data_axis="data",
+            remat_policy="dots_saveable", sharded_update=True)
+        assert pp.su_buckets is not None   # the composition is LIVE
+        p_pp = pp.import_params(m_pp.params)
+        o_pp = pp.import_opt_state(sgd_pp.init_state(m_pp.params))
+        assert "_su" in o_pp               # bucket-slice optimizer state
+        step = jax.jit(pp.make_train_step())
+
+        p_ref, s_ref = m_ref.params, m_ref.state
+        rs = np.random.default_rng(0)
+        rng = jax.random.PRNGKey(7)
+        for _ in range(3):
+            data, labels = (jnp.asarray(rs.standard_normal((8, 8))
+                                        .astype(np.float32))
+                            for _ in range(2))
+            rng, sk = jax.random.split(rng)
+            ep = jnp.asarray(1, jnp.int32)
+            p_ref, s_ref, o_ref, l_ref = ref(p_ref, s_ref, o_ref, sk,
+                                             data, labels, ep)
+            p_pp, _, o_pp, l_pp = step(p_pp, m_pp.state, o_pp, sk,
+                                       data, labels, ep)
+            np.testing.assert_allclose(float(l_ref), float(l_pp),
+                                       rtol=1e-6)
+        pt = jax.device_get(pp.gather_params(p_pp))
+        for a, b in zip(jax.tree.leaves(jax.device_get(p_ref)),
+                        jax.tree.leaves(pt)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        # ZeRO-compatible checkpoint seam: the bucket-slice state
+        # exports back to the params-shaped velocity tree
+        exported = pp.export_opt_state(o_pp)
+        assert set(exported) >= {"velocity", "neval", "epoch"}
+        for a, b in zip(jax.tree.leaves(jax.device_get(
+                            o_ref["velocity"])),
+                        jax.tree.leaves(exported["velocity"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        Engine.reset()
+
+
+class TestValidation:
+    def test_heterogeneous_blocks_refused(self):
+        m = nn.Sequential(nn.Sequential(nn.Linear(8, 8), nn.Tanh()),
+                          nn.Sequential(nn.Linear(8, 4), nn.Tanh()))
+        m.materialize(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="structurally identical"):
+            partition_sequential(m, 2)
+
+    def test_stateful_blocks_refused(self):
+        m = nn.Sequential()
+        for _ in range(2):
+            m.add(nn.Sequential(nn.Linear(8, 8), nn.BatchNormalization(8)))
+        m.materialize(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="stateless"):
+            partition_sequential(m, 2)
+
+    def test_indivisible_layers_refused(self):
+        m = build_model(n_blocks=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            partition_sequential(m, 2)
+
+    def test_non_sequential_refused(self):
+        m = nn.Linear(8, 8)
+        m.materialize(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="Sequential"):
+            partition_sequential(m, 2)
+
+    def test_missing_pipe_axis_refused(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 2}, devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="'pipe' mesh axis"):
+            PipelineParallel(mesh, build_model(), nn.MSECriterion(),
+                             SGD(), n_stages=2, num_microbatches=4)
+        Engine.reset()
+
+    def test_local_optimizer_refuses_pipeline(self):
+        from bigdl_tpu.dataset import Sample, array, SampleToBatch
+        rs = np.random.default_rng(0)
+        x = rs.random((16, 8)).astype(np.float32)
+        y = rs.random((16, 8)).astype(np.float32)
+        ds = array([Sample(x[i], y[i]) for i in range(16)]) \
+            >> SampleToBatch(8)
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        o = LocalOptimizer(build_model(), ds, nn.MSECriterion(),
+                           pipeline_stages=2)
+        with pytest.raises(ValueError, match="mesh"):
+            o.optimize()
+
+    def test_pad_partial_batches_refused_in_step(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"pipe": 2}, devices=jax.devices()[:2])
+        pp = PipelineParallel(mesh, build_model(), nn.MSECriterion(),
+                              SGD(), n_stages=2, num_microbatches=4)
+        step = pp.make_train_step()
+        data, labels = make_batch()
+        with pytest.raises(ValueError, match="pad_partial_batches"):
+            step(pp.import_params(pp.model.params), pp.model.state, {},
+                 jax.random.PRNGKey(0), data, labels,
+                 jnp.asarray(1, jnp.int32), n_valid=7)
+        Engine.reset()
+
+    def test_indivisible_batch_refused_at_trace(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"pipe": 2}, devices=jax.devices()[:2])
+        pp = PipelineParallel(mesh, build_model(), nn.MSECriterion(),
+                              SGD(), n_stages=2, num_microbatches=4)
+        step = pp.make_train_step()
+        data, labels = make_batch(batch=6)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(pp.import_params(pp.model.params), pp.model.state, {},
+                 jax.random.PRNGKey(0), data, labels,
+                 jnp.asarray(1, jnp.int32))
+        Engine.reset()
+
+    def test_per_leaf_hyperparams_refused(self):
+        Engine.reset()
+        mesh = Engine.init(axes={"pipe": 2}, devices=jax.devices()[:2])
+        model = build_model()
+        lrs = jax.tree.map(lambda _: 0.1, model.params)
+        with pytest.raises(ValueError, match="scalar hyperparameters"):
+            PipelineParallel(mesh, model, nn.MSECriterion(),
+                             SGD(learning_rates=lrs), n_stages=2,
+                             num_microbatches=4)
+        Engine.reset()
+
+
+class TestAOTCacheKeys:
+    """Acceptance: pipeline_stages / expert_parallel changes correctly
+    MISS the AOT executable cache — the knobs are program identity at
+    identical shapes."""
+
+    def _opt(self, **kw):
+        from bigdl_tpu.dataset import Sample, array, SampleToBatch
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        Engine.reset()
+        mesh = Engine.init(axes={"data": 2}, devices=jax.devices()[:2])
+        rs = np.random.default_rng(0)
+        x = rs.random((16, 8)).astype(np.float32)
+        ds = array([Sample(x[i], x[i]) for i in range(16)]) \
+            >> SampleToBatch(8)
+        return DistriOptimizer(build_model(), ds, nn.MSECriterion(),
+                               mesh=mesh, **kw)
+
+    def test_pipeline_and_expert_knobs_key_the_cache(self):
+        from bigdl_tpu.tuning.aot_cache import stable_repr
+        base = self._opt()
+        keys = {stable_repr(base._step_key_extra()): "base"}
+        for name, kw in [
+                ("stages", dict(pipeline_stages=2)),
+                ("schedule", dict(pipeline_stages=2,
+                                  pipeline_schedule="gpipe")),
+                ("virtual", dict(pipeline_stages=2,
+                                 pipeline_schedule="interleaved_1f1b",
+                                 pipeline_virtual_stages=2)),
+                ("expert", dict(expert_parallel=True)),
+                ("aux", dict(expert_parallel=True,
+                             expert_aux_weight=0.5))]:
+            key = stable_repr(self._opt(**kw)._step_key_extra())
+            assert key not in keys, (name, keys[key])
+            keys[key] = name
+
+    def test_default_knobs_are_the_plain_step_key(self):
+        """Never-configured == explicitly-default: one cache entry."""
+        from bigdl_tpu.tuning.aot_cache import stable_repr
+        a = self._opt()
+        b = self._opt(pipeline_stages=1, pipeline_schedule="1f1b",
+                      pipeline_virtual_stages=1)
+        assert stable_repr(a._step_key_extra()) == \
+            stable_repr(b._step_key_extra())
+        Engine.reset()
+
+
+@pytest.mark.slow
+class TestFullLoopIntegration:
+    """DistriOptimizer end-to-end on the pipeline path: full training
+    loops (prefetch, async dispatch, drain, sync) at every schedule
+    match the plain data-parallel accumulated run."""
+
+    def _run(self, pipeline, sched="1f1b", v=1, su=False):
+        from bigdl_tpu.dataset import Sample, array, SampleToBatch
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        from bigdl_tpu.optim.validation import Loss
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.utils.random import RandomGenerator
+        Engine.reset()
+        RandomGenerator.set_seed(1)
+        if pipeline:
+            mesh = Engine.init(axes={"data": 2, "pipe": 2},
+                               devices=jax.devices()[:4])
+        else:
+            mesh = Engine.init(axes={"data": 2},
+                               devices=jax.devices()[:2])
+        model = build_model()
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 8).astype(np.float32)
+        y = rs.rand(64, 8).astype(np.float32)
+        ds = array([Sample(x[i], y[i]) for i in range(64)]) \
+            >> SampleToBatch(16, drop_remainder=True)
+        kw = dict(mesh=mesh)
+        if pipeline:
+            kw.update(pipeline_stages=2, pipeline_schedule=sched,
+                      pipeline_virtual_stages=v)
+        o = DistriOptimizer(model, ds, nn.MSECriterion(), **kw)
+        o.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+        o.set_grad_accumulation(4)
+        if su:
+            o.set_sharded_update(True)
+        o.set_end_when(optim.max_iteration(6))
+        o.optimize()
+        return jax.device_get(model.params)
+
+    def test_full_loop_parity_all_schedules(self):
+        ref = self._run(False)
+
+        def diff(p):
+            return max(float(np.max(np.abs(np.asarray(a)
+                                           - np.asarray(b))))
+                       for a, b in zip(jax.tree.leaves(ref),
+                                       jax.tree.leaves(p)))
+
+        assert diff(self._run(True)) < 5e-6
+        assert diff(self._run(True, su=True)) < 5e-6
+        assert diff(self._run(True, sched="interleaved_1f1b",
+                              v=2)) < 5e-6
+        assert diff(self._run(True, sched="gpipe")) < 5e-6

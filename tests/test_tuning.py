@@ -552,3 +552,77 @@ class TestWarmRestartParity:
 # bench row wiring lives in test_bench_contract.py; the probe itself is
 # exercised there on the fast geometry.
 # ---------------------------------------------------------------------------
+
+
+class TestPipelineScheduleCandidates:
+    """ISSUE 11: the pipeline schedule search axis — candidates legal by
+    construction, HBM estimator follows the schedule's exact stash."""
+
+    def test_candidates_grid_and_legality(self):
+        from bigdl_tpu.tuning.autotuner import \
+            pipeline_schedule_candidates
+        cands = pipeline_schedule_candidates(32, n_layers=8,
+                                             stage_counts=(2, 4, 3))
+        assert cands, "empty candidate grid"
+        for c in cands:
+            assert c["schedule"] in ("gpipe", "1f1b",
+                                     "interleaved_1f1b")
+            assert 32 % c["num_microbatches"] == 0
+            assert 8 % (c["stages"] * c["virtual_stages"]) == 0
+            if c["schedule"] == "interleaved_1f1b":
+                assert c["virtual_stages"] > 1
+                assert c["num_microbatches"] % c["stages"] == 0
+            else:
+                assert c["virtual_stages"] == 1
+        # stage count 3 does not divide 8 layers -> never emitted
+        assert all(c["stages"] != 3 for c in cands)
+        # every schedule family present
+        assert {c["schedule"] for c in cands} == {
+            "gpipe", "1f1b", "interleaved_1f1b"}
+
+    def test_est_hbm_tracks_schedule_stash(self):
+        from bigdl_tpu.tuning.autotuner import pipeline_est_hbm
+        est = pipeline_est_hbm(act_bytes_full_batch=8 << 20,
+                               persistent_bytes=4 << 20)
+        gp = est({"schedule": "gpipe", "num_microbatches": 8,
+                  "stages": 4, "virtual_stages": 1})
+        fb = est({"schedule": "1f1b", "num_microbatches": 8,
+                  "stages": 4, "virtual_stages": 1})
+        # gpipe stashes all M microbatches, 1f1b ~S: at M=8, S=4 the
+        # activation term halves
+        assert fb < gp
+        act = (8 << 20) // 8
+        assert gp == (4 << 20) // 4 + 8 * act
+        assert fb == (4 << 20) // 4 + 4 * act
+        # more microbatches shrink the per-microbatch term for 1f1b
+        fb16 = est({"schedule": "1f1b", "num_microbatches": 16,
+                    "stages": 4, "virtual_stages": 1})
+        assert fb16 < fb
+
+    def test_est_hbm_prunes_in_tune_without_building(self):
+        from bigdl_tpu.tuning.autotuner import (pipeline_est_hbm,
+                                                tune)
+        from bigdl_tpu.tuning.records import TuningRecords
+
+        built = []
+
+        def build(c):
+            built.append(c["schedule"])
+            return lambda: 0.0
+
+        # gpipe stashes 4 microbatches -> 1 GiB, over the 512 MiB
+        # budget; 1f1b stashes 2 -> exactly at budget, survives
+        est = pipeline_est_hbm(act_bytes_full_batch=1 << 30)
+        res = tune(build,
+                   [{"schedule": "gpipe", "num_microbatches": 4,
+                     "stages": 2, "virtual_stages": 1},
+                    {"schedule": "1f1b", "num_microbatches": 4,
+                     "stages": 2, "virtual_stages": 1}],
+                   key=("pipeline_schedule", "test"),
+                   records=TuningRecords(), est_vmem=est,
+                   vmem_budget=(1 << 29),
+                   persist=False)
+        assert res.config["schedule"] == "1f1b"
+        assert built == ["1f1b"]        # gpipe never compiled
+        skipped = [m for m in res.measurements if m.skipped]
+        assert any("pruned" in m.skipped for m in skipped)
