@@ -368,6 +368,188 @@ def bench_compile_cold_start(model: str = "inception_v1",
     }
 
 
+def _elastic_probe_dataset():
+    """Shared trainer/resume dataset for the elastic probes: the tiny
+    XOR geometry — steps are milliseconds, so the parent's SIGKILL
+    lands mid-run and the resume cost measured is the elastic machinery
+    (load + redistribute + step construction), not the model."""
+    from bigdl_tpu.dataset import Sample, SampleToBatch, array
+    rs = np.random.RandomState(0)
+    x = rs.rand(128, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    return array([Sample(x[i], y[i]) for i in range(128)],
+                 num_shards=1) >> SampleToBatch(16, drop_remainder=True)
+
+
+def _elastic_model_optim():
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    return model, optim.SGD(learning_rate=0.3, momentum=0.9)
+
+
+def _elastic_train_probe_main(ckpt_dir: str) -> None:
+    """--elastic-train-probe subprocess entry: a distributed training
+    run checkpointing asynchronously every 8 iterations into
+    ``ckpt_dir``. It never finishes on its own — the parent SIGKILLs it
+    once a complete manifest lands, the same failure the elastic
+    subsystem exists to absorb."""
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(5)
+    Engine.init()
+    model, method = _elastic_model_optim()
+    o = optim.Optimizer(model=model, dataset=_elastic_probe_dataset(),
+                        criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(method)
+    o.set_checkpoint(ckpt_dir, optim.several_iteration(8))
+    o.set_end_when(optim.max_iteration(1_000_000))
+    o.optimize()
+
+
+def _elastic_resume_probe_main(ckpt_dir: str, cache_dir: str) -> None:
+    """--elastic-resume-probe subprocess entry: time kill-to-first-step
+    on a RESIZED mesh (the parent forces a different virtual device
+    count): load the latest manifest-complete snapshot, redistribute
+    onto this mesh, and run ONE training step through the persistent
+    AOT executable cache."""
+    import logging
+
+    import jax
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu import elastic
+    from bigdl_tpu.parallel import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(5)
+    t0 = time.perf_counter()
+    model, state, man = elastic.load_checkpoint(ckpt_dir)
+    load_s = time.perf_counter() - t0
+    Engine.init()
+    _, method = _elastic_model_optim()
+    o = optim.Optimizer(model=model, dataset=_elastic_probe_dataset(),
+                        criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(method)
+    o.set_state(state)
+    o.set_aot_cache(cache_dir)
+    resumed_neval = int(man["neval"])
+    o.set_end_when(lambda s: s["neval"] > resumed_neval + 1)
+    losses = []
+
+    class _Rec(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if "loss is" in msg:
+                losses.append(float(
+                    msg.split("loss is ")[1].split(",")[0]))
+
+    lg = logging.getLogger("bigdl_tpu.optim")
+    lg.addHandler(_Rec())
+    lg.setLevel(logging.INFO)
+    t1 = time.perf_counter()
+    o.optimize()
+    first_step_s = time.perf_counter() - t1
+    cache = o._aot_cache()
+    _emit({"load_s": load_s, "first_step_s": first_step_s,
+           "resume_to_first_step_s": load_s + first_step_s,
+           "resumed_neval": resumed_neval,
+           "loss": losses[-1] if losses else None,
+           "cache_hits": cache.hits, "cache_misses": cache.misses,
+           "mesh_devices": jax.device_count()})
+
+
+def bench_elastic_resume_secs(train_devices: int = 8,
+                              resume_devices: int = 4,
+                              ckpt_dir: str | None = None,
+                              timeout_s: float = 300.0):
+    """Elastic restart latency (ISSUE 14): SIGKILL a checkpointing
+    trainer mid-run, then resume on a RESIZED mesh from the latest
+    manifest-complete snapshot. Two resume subprocesses share one AOT
+    cache directory: the first pays the step compile (first restart of
+    a geometry), the second deserializes (the steady-state fleet
+    restart). ``value`` is the warm kill-to-first-resumed-step wall
+    time in seconds — the window of lost work a preemption costs beyond
+    the steps since the last checkpoint. Children run on the CPU
+    backend (the parent may hold the TPU); mesh sizes are virtual
+    device counts."""
+    import subprocess
+    import tempfile
+
+    from bigdl_tpu.elastic import latest_checkpoint
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(
+        prefix="bigdl_tpu_elastic_bench_")
+    cache_dir = tempfile.mkdtemp(prefix="bigdl_tpu_elastic_aot_")
+    env_train = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS=_xla_flags_with_device_count(int(train_devices)))
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--elastic-train-probe", ckpt_dir],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        env=env_train)
+    try:
+        deadline = time.monotonic() + timeout_s
+        man = None
+        while time.monotonic() < deadline:
+            man = latest_checkpoint(ckpt_dir)
+            if man is not None:
+                break
+            if p.poll() is not None:
+                tail = (p.stderr.read() or "").strip().splitlines()[-3:]
+                raise RuntimeError(
+                    f"elastic train probe exited rc={p.returncode} "
+                    "before writing a checkpoint: "
+                    + (" | ".join(tail) or "no output"))
+            time.sleep(0.2)
+        if man is None:
+            raise RuntimeError("elastic train probe wrote no checkpoint "
+                               f"within {timeout_s}s")
+    finally:
+        p.kill()
+        p.wait(timeout=30)
+    env_resume = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS=_xla_flags_with_device_count(int(resume_devices)))
+    out = {}
+    for phase in ("cold", "warm"):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--elastic-resume-probe", ckpt_dir,
+             "--elastic-resume-cache", cache_dir],
+            capture_output=True, text=True, timeout=1200, env=env_resume)
+        payload = None
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                payload = json.loads(line)
+        if payload is None:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            raise RuntimeError(
+                f"elastic {phase} resume probe rc={r.returncode}: "
+                + (" | ".join(tail) or "no output"))
+        out[phase] = payload
+    cold, warm = out["cold"], out["warm"]
+    return {
+        "metric": "elastic_resume_secs",
+        "value": round(warm["resume_to_first_step_s"], 3),
+        "unit": "s (kill -> first resumed step, warm AOT cache, "
+                f"{train_devices}->{resume_devices} mesh)",
+        "cold_resume_s": round(cold["resume_to_first_step_s"], 3),
+        "warm_resume_s": round(warm["resume_to_first_step_s"], 3),
+        "load_s": round(warm["load_s"], 3),
+        "resumed_neval": warm["resumed_neval"],
+        "warm_cache_hits": warm["cache_hits"],
+        "warm_cache_misses": warm["cache_misses"],
+        "loss_bit_identical": cold["loss"] == warm["loss"],
+        "ckpt_dir": ckpt_dir,
+    }
+
+
 def bench_train_peak_hbm(**geometry):
     """Static peak-HBM accounting for the transformer train step across
     remat policies at FIXED effective batch (ISSUE 10 — the tentpole's
@@ -1564,7 +1746,8 @@ def main(argv=None):
                              "compile_cold_start,"
                              "serving_decode_hbm_bytes,"
                              "train_peak_hbm_bytes,multichip_scaling,"
-                             "pipeline_bubble_fraction")
+                             "pipeline_bubble_fraction,"
+                             "elastic_resume_secs")
     parser.add_argument("--gate", default=None, metavar="BASELINE_JSON",
                         help="compare this run's rows against a "
                              "recorded baseline (per-row thresholds); "
@@ -1614,6 +1797,14 @@ def main(argv=None):
                         help=argparse.SUPPRESS)
     parser.add_argument("--cold-start-batch", type=int, default=16,
                         help=argparse.SUPPRESS)
+    parser.add_argument("--elastic-train-probe", default=None,
+                        metavar="CKPT_DIR",
+                        help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--elastic-resume-probe", default=None,
+                        metavar="CKPT_DIR",
+                        help=argparse.SUPPRESS)   # subprocess entry
+    parser.add_argument("--elastic-resume-cache", default=None,
+                        help=argparse.SUPPRESS)
     parser.add_argument("--train-hbm-probe", action="store_true",
                         help=argparse.SUPPRESS)   # subprocess entry
     parser.add_argument("--train-hbm-geometry", default="{}",
@@ -1655,6 +1846,13 @@ def main(argv=None):
         _cold_start_probe_main(args.cold_start_probe,
                                args.cold_start_model,
                                args.cold_start_batch)
+        return
+    if args.elastic_train_probe is not None:
+        _elastic_train_probe_main(args.elastic_train_probe)
+        return
+    if args.elastic_resume_probe is not None:
+        _elastic_resume_probe_main(args.elastic_resume_probe,
+                                   args.elastic_resume_cache)
         return
     if args.train_hbm_probe:
         _train_hbm_probe_main(args.train_hbm_geometry)
@@ -1727,7 +1925,7 @@ def _run(args):
                 "collective_wire_bytes_per_step",
                 "compile_cold_start", "serving_decode_hbm_bytes",
                 "train_peak_hbm_bytes", "multichip_scaling",
-                "pipeline_bubble_fraction"]
+                "pipeline_bubble_fraction", "elastic_resume_secs"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -1735,7 +1933,8 @@ def _run(args):
              "serving_ttft", "serving_tokens_per_sec", "train_mfu",
              "collective_wire_bytes_per_step", "compile_cold_start",
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
-             "multichip_scaling", "pipeline_bubble_fraction"}
+             "multichip_scaling", "pipeline_bubble_fraction",
+             "elastic_resume_secs"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -1788,6 +1987,7 @@ def _run(args):
         "train_peak_hbm_bytes": bench_train_peak_hbm,
         "multichip_scaling": bench_multichip_scaling,
         "pipeline_bubble_fraction": bench_pipeline_bubble,
+        "elastic_resume_secs": bench_elastic_resume_secs,
     }
     rows_out: list[dict] = []
     headline_failed = False

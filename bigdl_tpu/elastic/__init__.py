@@ -1,0 +1,90 @@
+"""Elastic training: async checkpointing, mesh-portable resume, restart.
+
+ROADMAP item 1 rendered as a subsystem (docs/ELASTICITY.md): training
+runs were bit-exact to checkpoint but mesh-shape-bound — losing a host
+(or gaining chips) meant a lost run. The three pillars here turn a
+preemption into a resize:
+
+- :mod:`~bigdl_tpu.elastic.checkpoint_writer` — ``CheckpointWriter``
+  runs checkpoint serialization on a background worker thread; the
+  training loop pays one packed ``jax.device_get``
+  (``snapshot_to_host``) and hands off.
+- :mod:`~bigdl_tpu.elastic.manifest` — the versioned, manifest-carrying
+  checkpoint format (logical leaf shapes/dtypes + mesh descriptor;
+  manifest committed last, so ``latest_checkpoint`` never sees a torn
+  snapshot), plus :mod:`~bigdl_tpu.elastic.redistribute` placing the
+  saved host arrays onto ANY target mesh (arXiv:2112.01075's portable
+  arrays, applied to checkpoints).
+- :mod:`~bigdl_tpu.elastic.runner` — ``ElasticRunner`` supervises a
+  training child, watches the ``training_liveness`` health check, and
+  on death/wedge dumps a flight-recorder postmortem and respawns from
+  the latest manifest.
+
+HOST-ONLY CONTRACT (jaxlint JX5): every module here lazy-imports jax —
+the supervisor and manifest tooling must run with no device runtime.
+"""
+from bigdl_tpu.elastic.checkpoint_writer import (CheckpointWriter,
+                                                 snapshot_to_host)
+from bigdl_tpu.elastic.manifest import (MANIFEST_FORMAT, MANIFEST_VERSION,
+                                        build_manifest, latest_checkpoint,
+                                        manifest_name, mesh_layout,
+                                        read_manifest, validate_tree,
+                                        write_manifest)
+from bigdl_tpu.elastic.redistribute import describe_layout, redistribute
+from bigdl_tpu.elastic.runner import (ElasticRunner, ProcessChild,
+                                      probe_liveness)
+
+__all__ = ["CheckpointWriter", "ElasticRunner", "MANIFEST_FORMAT",
+           "MANIFEST_VERSION", "ProcessChild", "build_manifest",
+           "describe_layout", "latest_checkpoint", "load_checkpoint",
+           "manifest_name", "mesh_layout", "probe_liveness",
+           "read_manifest", "redistribute", "snapshot_to_host",
+           "validate_tree", "write_manifest"]
+
+
+def _member_path(dir_path: str, name: str) -> str:
+    if "://" in str(dir_path):
+        return f"{dir_path}/{name}"
+    import os
+    return os.path.join(dir_path, name)
+
+
+def load_checkpoint(path: str, *, neval: int | None = None,
+                    validate: bool = True):
+    """Load one complete checkpoint from ``path``: ``(model, state,
+    manifest)``. ``neval=None`` picks the newest manifest; an explicit
+    ``neval`` loads that snapshot. ``state`` is the full training-state
+    dict the optimizers save (driver counters, opt_state, rng, data
+    position, ``mesh_layout``) — hand it to ``Optimizer.set_state`` and
+    the run resumes on WHATEVER mesh the new process initializes
+    (``redistribute`` does the placement). ``validate`` checks every
+    loaded leaf against the manifest's recorded shapes/dtypes."""
+    if neval is None:
+        man = latest_checkpoint(path)
+        if man is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint manifest under {path!r} — "
+                "nothing to resume from (was the checkpoint written by "
+                "a pre-elastic build? see docs/ELASTICITY.md)")
+    else:
+        man = None
+        for name in (manifest_name(f".{int(neval)}"), manifest_name("")):
+            try:
+                man = read_manifest(_member_path(path, name))
+            except (FileNotFoundError, OSError):
+                continue
+            if int(man["neval"]) == int(neval):
+                break
+            man = None
+        if man is None:
+            raise FileNotFoundError(
+                f"no checkpoint manifest for neval={neval} under "
+                f"{path!r}")
+    from bigdl_tpu.utils import file as _file
+    model = _file.load_module(_member_path(path, man["model"]))
+    state = _file.load(_member_path(path, man["state"]))
+    if validate:
+        validate_tree(model.params, man.get("params"), "params")
+        validate_tree(state.get("opt_state"), man.get("opt_state"),
+                      "optimizer state")
+    return model, state, man

@@ -1,0 +1,237 @@
+"""Async checkpoint writer: serialization off the training critical path.
+
+The synchronous ``Optimizer._checkpoint`` paid the full save on the
+training thread: device_get, clone, pickle, zip, rename — all while the
+device pipeline drained (the train step donates its inputs, so nothing
+can dispatch until the host owns the values anyway, but everything
+AFTER the readback is pure host work the loop does not need to wait
+for). This module splits the save at exactly that line:
+
+- :func:`snapshot_to_host` — the ONE packed ``jax.device_get`` of every
+  device leaf across params/opt-state/RNG, issued on the training
+  thread (correctness: the next step's ``donate_argnums`` buffers must
+  not be rewritten under a pending readback).
+- :class:`CheckpointWriter` — a bounded-queue daemon worker (the
+  dataset/prefetch.py worker-thread pattern on the save side) that runs
+  the serialize + atomic-rename job in the background while training
+  dispatches ahead. ``submit`` hands off; ``barrier`` waits the queue
+  dry (epoch end, exit); ``close`` drains and joins. Worker exceptions
+  are stored and re-raised at the next submit/barrier — a failed save
+  must fail the run, not vanish into a dead thread.
+
+The handoff/write split is exported as the ``elastic_ckpt_save_overhead``
+receipt: ``handoff_s`` is what the critical path still pays (snapshot +
+enqueue), ``write_s`` is what moved to the worker, and their ratio is
+the receipt the bench row and tests pin.
+
+HOST-ONLY CONTRACT (jaxlint JX5): no module-level jax import — the
+queue/thread machinery is importable with no device runtime; jax is
+lazily imported only inside :func:`snapshot_to_host`.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from bigdl_tpu.observability.registry import default_registry
+
+__all__ = ["CheckpointWriter", "snapshot_to_host"]
+
+logger = logging.getLogger("bigdl_tpu.elastic")
+
+
+def snapshot_to_host(tree):
+    """Copy every device leaf of ``tree`` to host numpy with one packed
+    ``jax.device_get`` (single transfer program, not a per-leaf sync).
+    Non-addressable leaves (multi-host shards) are allgathered first so
+    the snapshot always holds global arrays — same contract as
+    ``utils.file._to_host``, minus the per-leaf transfers."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    device_idx = [i for i, l in enumerate(leaves)
+                  if isinstance(l, jax.Array)]
+    gathered = []
+    for i in device_idx:
+        leaf = leaves[i]
+        if not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
+        gathered.append(leaf)
+    host = jax.device_get(gathered)
+    for i, arr in zip(device_idx, host):
+        leaves[i] = np.asarray(arr)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class CheckpointWriter:
+    """Bounded-queue background checkpoint writer.
+
+    One daemon worker runs submitted save jobs strictly in submission
+    order (overwrite-mode checkpoints depend on it: the newest snapshot
+    must land last). ``depth`` bounds how many snapshots can be pending
+    in host memory at once — a slow filesystem backpressures ``submit``
+    instead of accumulating unbounded host copies.
+
+    Observability: ``elastic_ckpt_pending`` gauge (snapshots queued or
+    writing), ``elastic_ckpt_saves_total`` counter, and the
+    ``elastic_ckpt_save_overhead`` gauge holding the last save's
+    background write seconds — the cost the critical path no longer
+    pays. :meth:`receipt` aggregates the same split per run.
+    """
+
+    def __init__(self, *, name: str = "ckpt", depth: int = 2,
+                 timeout: float = 120.0):
+        if depth < 1:
+            raise ValueError(f"writer depth must be >= 1, got {depth}")
+        self._name = name
+        self._timeout = timeout
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._cond = threading.Condition()
+        self._pending = 0
+        self._error: BaseException | None = None
+        self._closed = False
+        self._saves = 0
+        self._handoff_s = 0.0
+        self._write_s = 0.0
+        reg = default_registry()
+        self._pending_gauge = reg.gauge(
+            "elastic_ckpt_pending",
+            "checkpoint snapshots queued or being written",
+            labelnames=("writer",))
+        self._overhead_gauge = reg.gauge(
+            "elastic_ckpt_save_overhead",
+            "seconds of checkpoint serialization moved off the critical "
+            "path by the last async save", labelnames=("writer",))
+        self._saves_total = reg.counter(
+            "elastic_ckpt_saves_total",
+            "checkpoint snapshots committed by the async writer",
+            labelnames=("writer",))
+        self._worker = threading.Thread(
+            target=self._work, name=f"ckpt-writer:{name}", daemon=True)
+        self._worker.start()
+
+    # -- worker side --
+    def _work(self):
+        while not self._stop.is_set():
+            try:
+                job, label = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            t0 = time.perf_counter()
+            try:
+                job()
+            except BaseException as e:
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+                logger.exception("async checkpoint save %r failed", label)
+            else:
+                dt = time.perf_counter() - t0
+                with self._cond:
+                    self._saves += 1
+                    self._write_s += dt
+                self._saves_total.inc(writer=self._name)
+                self._overhead_gauge.set(dt, writer=self._name)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._pending_gauge.set(self._pending,
+                                            writer=self._name)
+                    self._cond.notify_all()
+
+    # -- training-thread side --
+    def submit(self, job, *, label: str = "", handoff_s: float = 0.0):
+        """Queue one save job (a zero-arg callable over host-only data).
+        Raises the first stored worker error — a checkpoint that failed
+        in the background surfaces on the training thread at the next
+        fire, before the run can outlive its last good snapshot."""
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    f"async checkpoint save failed in the background "
+                    f"(writer '{self._name}')") from err
+            if self._closed:
+                raise RuntimeError(
+                    f"checkpoint writer '{self._name}' is closed")
+            self._handoff_s += handoff_s
+            # count BEFORE the job is visible to the worker, else a fast
+            # write could decrement first and barrier would see 0 early
+            self._pending += 1
+            self._pending_gauge.set(self._pending, writer=self._name)
+        try:
+            self._q.put((job, label), timeout=self._timeout)
+        except queue.Full:
+            with self._cond:
+                self._pending -= 1
+                self._pending_gauge.set(self._pending, writer=self._name)
+                self._cond.notify_all()
+            raise RuntimeError(
+                f"checkpoint writer '{self._name}' queue stayed full for "
+                f"{self._timeout}s — the save job is wedged")
+
+    def barrier(self, timeout: float | None = None):
+        """Block until every submitted save has committed (epoch end /
+        exit ordering: the epoch-boundary shuffle and the final return
+        must not race a write in flight). Re-raises a stored worker
+        error once drained."""
+        deadline = self._timeout if timeout is None else timeout
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._pending == 0,
+                                       timeout=deadline):
+                raise RuntimeError(
+                    f"checkpoint writer '{self._name}' still has "
+                    f"{self._pending} pending saves after {deadline}s — "
+                    "the save job is wedged")
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    f"async checkpoint save failed in the background "
+                    f"(writer '{self._name}')") from err
+
+    def close(self, timeout: float | None = None):
+        """Drain, stop, join. Idempotent; raises if the worker refuses
+        to die (a wedged save should be loud, not silent)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.barrier(timeout=timeout)
+        finally:
+            self._stop.set()
+            self._worker.join(timeout=10.0)
+        if self._worker.is_alive():
+            raise RuntimeError(
+                f"checkpoint writer '{self._name}' did not stop — "
+                "save job is wedged")
+
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return self._pending
+
+    def receipt(self) -> dict:
+        """The save-overhead receipt: seconds the critical path paid
+        (``handoff_s``) vs seconds moved to the worker (``write_s``)."""
+        with self._cond:
+            handoff, write = self._handoff_s, self._write_s
+            total = handoff + write
+            return {
+                "saves": self._saves,
+                "handoff_s": handoff,
+                "write_s": write,
+                "off_critical_path_fraction":
+                    (write / total) if total > 0 else 0.0,
+            }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

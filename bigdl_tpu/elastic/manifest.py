@@ -1,0 +1,202 @@
+"""Manifest-carrying checkpoint format: the portable half of elastic resume.
+
+A checkpoint written by ``Optimizer.set_checkpoint`` is three files per
+trigger fire — ``model<suffix>`` (the pickled host module),
+``state<suffix>`` (driver counters + optimizer state + RNG + data
+position), and ``manifest<suffix>.json`` (this module). The manifest is
+deliberately the LAST file committed: :func:`latest_checkpoint` trusts
+only manifests, so a run killed between the model/state writes and the
+manifest write simply resumes from the previous complete snapshot —
+no torn checkpoint is ever eligible for resume (the per-file
+``.tmp`` + atomic-rename staging in ``utils/file.py`` guarantees no
+individual file is torn either).
+
+What makes the format mesh-portable (arXiv:2112.01075's portable-array
+idea rendered on checkpoints): the manifest records the LOGICAL leaf
+layout — flattened keypath -> shape + dtype for params and optimizer
+state — plus the mesh descriptor the arrays were saved under (axis
+names, sizes, device kinds; deliberately NOT device ids, matching the
+AOT cache key's elastic-restart stance, tuning/aot_cache.py
+``mesh_descriptor``). The arrays themselves are host-global numpy, so
+resuming on a different mesh is validation + placement
+(``redistribute``), never a data transform.
+
+HOST-ONLY CONTRACT (jaxlint JX5): no module-level jax import — manifest
+reading/listing must work in supervisors (``ElasticRunner``) that never
+initialize a device runtime. jax is imported lazily only inside the
+functions that flatten live trees.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+
+logger = logging.getLogger("bigdl_tpu.elastic")
+
+__all__ = ["MANIFEST_FORMAT", "MANIFEST_VERSION", "build_manifest",
+           "latest_checkpoint", "manifest_name", "mesh_layout",
+           "read_manifest", "validate_tree", "write_manifest"]
+
+MANIFEST_FORMAT = "bigdl_tpu.elastic.manifest"
+MANIFEST_VERSION = 1
+
+_MANIFEST_RE = re.compile(r"^manifest(\.\d+)?\.json$")
+
+
+def manifest_name(suffix: str = "") -> str:
+    """``manifest<suffix>.json`` — suffix matches the model/state files
+    (``""`` under ``overwrite_checkpoint``, ``.<neval>`` otherwise)."""
+    return f"manifest{suffix}.json"
+
+
+def mesh_layout(mesh) -> dict | None:
+    """JSON-able mesh descriptor: axis names + sizes + device kinds.
+    Device ids are deliberately excluded — the descriptor must compare
+    equal across restarts that land on different physical hosts."""
+    if mesh is None:
+        return None
+    kinds = sorted({str(getattr(d, "device_kind", d.platform))
+                    for d in mesh.devices.flat})
+    return {"axis_names": [str(a) for a in mesh.axis_names],
+            "axis_sizes": [int(mesh.shape[a]) for a in mesh.axis_names],
+            "device_kinds": kinds}
+
+
+def _leaf_specs(tree) -> dict:
+    """Flattened keypath -> {shape, dtype} for every array leaf (opaque
+    leaves — bytes, strings — are recorded by type name only)."""
+    if tree is None:
+        return {}
+    import jax
+    import numpy as np
+    specs: dict = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path) or "<root>"
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            specs[key] = {"shape": [int(s) for s in leaf.shape],
+                          "dtype": str(np.dtype(leaf.dtype))}
+        elif np.isscalar(leaf) and not isinstance(leaf, (str, bytes)):
+            # a bare python number — device/numpy scalars carry
+            # shape+dtype and took the branch above
+            specs[key] = {"shape": [],
+                          "dtype": str(np.dtype(type(leaf)))}
+        else:
+            specs[key] = {"opaque": type(leaf).__name__}
+    return specs
+
+
+def build_manifest(*, neval: int, epoch: int, model_file: str,
+                   state_file: str, params=None, opt_state=None,
+                   mesh=None, extra: dict | None = None) -> dict:
+    """Assemble the manifest dict for one checkpoint snapshot. The
+    params/opt_state trees must already be HOST trees (the async
+    writer's snapshot) — building a manifest must never read a device
+    value."""
+    man = {
+        "format": MANIFEST_FORMAT,
+        "version": MANIFEST_VERSION,
+        "neval": int(neval),
+        "epoch": int(epoch),
+        "model": str(model_file),
+        "state": str(state_file),
+        "mesh": mesh_layout(mesh) if not isinstance(mesh, dict) else mesh,
+        "params": _leaf_specs(params),
+        "opt_state": _leaf_specs(opt_state),
+    }
+    if extra:
+        man["extra"] = dict(extra)
+    return man
+
+
+def write_manifest(manifest: dict, path: str) -> None:
+    """Atomic manifest write (temp name + rename via the checkpoint IO
+    staging, utils/file.py) — a crash mid-write never leaves a torn
+    manifest that :func:`latest_checkpoint` would trust."""
+    from bigdl_tpu.utils.file import _open_write_atomic
+    body = json.dumps(manifest, indent=2, sort_keys=True).encode()
+    with _open_write_atomic(path) as f:
+        f.write(body)
+
+
+def read_manifest(path: str) -> dict:
+    """Load + sanity-check one manifest file."""
+    from bigdl_tpu.utils.file import _open_read
+    with _open_read(path) as f:
+        man = json.loads(f.read().decode())
+    if man.get("format") != MANIFEST_FORMAT:
+        raise ValueError(f"{path} is not an elastic checkpoint manifest "
+                         f"(format={man.get('format')!r})")
+    if int(man.get("version", -1)) > MANIFEST_VERSION:
+        raise ValueError(
+            f"{path} is manifest version {man['version']}, newer than "
+            f"this build understands ({MANIFEST_VERSION}) — upgrade "
+            "before resuming")
+    return man
+
+
+def _list_manifest_names(path: str) -> list[str]:
+    from bigdl_tpu.utils.file import _fs_for, _is_url
+    if _is_url(path):
+        fs = _fs_for(path)
+        try:
+            names = [str(n).rsplit("/", 1)[-1]
+                     for n in fs.ls(path, detail=False)]
+        except FileNotFoundError:
+            return []
+    else:
+        try:
+            names = os.listdir(path)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+    return sorted(n for n in names if _MANIFEST_RE.match(n))
+
+
+def latest_checkpoint(path: str) -> dict | None:
+    """The newest COMPLETE checkpoint under ``path``: scan manifests,
+    skip unreadable/torn ones with a warning, return the highest-neval
+    manifest (or None when the directory holds no complete snapshot —
+    a fresh start, not an error: the elastic runner's first attempt
+    and a post-crash resume share this call."""
+    best = None
+    for name in _list_manifest_names(path):
+        full = f"{path}/{name}" if "://" in str(path) \
+            else os.path.join(path, name)
+        try:
+            man = read_manifest(full)
+        except Exception as e:
+            logger.warning("skipping unreadable checkpoint manifest "
+                           "%s: %s", full, e)
+            continue
+        if best is None or int(man["neval"]) > int(best["neval"]):
+            best = man
+    return best
+
+
+def validate_tree(tree, specs: dict | None, what: str) -> None:
+    """Leaf-by-leaf shape/dtype validation of a loaded tree against the
+    manifest's recorded layout — the guard that turns silent shape drift
+    (a truncated file, a changed model) into one clear error before any
+    device placement happens."""
+    if specs is None:
+        return
+    got = _leaf_specs(tree)
+    problems = []
+    for key in sorted(set(specs) | set(got)):
+        want_spec, got_spec = specs.get(key), got.get(key)
+        if want_spec is None:
+            problems.append(f"{key}: not in manifest")
+        elif got_spec is None:
+            problems.append(f"{key}: missing from loaded {what}")
+        elif want_spec != got_spec:
+            problems.append(f"{key}: manifest {want_spec} != loaded "
+                            f"{got_spec}")
+        if len(problems) >= 5:
+            problems.append("...")
+            break
+    if problems:
+        raise ValueError(
+            f"loaded {what} does not match the checkpoint manifest "
+            f"({len(problems)} mismatches): " + "; ".join(problems))

@@ -1,0 +1,92 @@
+"""Mesh-portable placement: put saved host arrays onto ANY target mesh.
+
+The checkpoint holds host-global numpy (``snapshot_to_host`` /
+``utils.file._to_host`` allgather before writing), so a resume is pure
+placement — there is no data transform between mesh shapes. What this
+module adds over a bare ``device_put`` is the elastic bookkeeping: it
+reads the manifest's saved mesh layout, logs the resize (8 devices →
+4 devices is a routine event, not an anomaly), and routes every leaf
+through the right placement primitive for the current topology:
+
+- single-controller (the common case, and all CPU test meshes):
+  ``jax.device_put`` with the target sharding — XLA splits the host
+  array across the new device set directly.
+- multi-process meshes: ``jax.make_array_from_callback`` assembles each
+  global array from per-shard numpy slices — the host-global
+  generalization of ``make_array_from_process_local_data`` (which wants
+  a per-process LOCAL shard; a checkpoint restore holds the GLOBAL
+  value on every process). True multi-host redistribution beyond a
+  single controller (per-process partial reads) is a documented
+  leftover in ROADMAP item 1.
+
+Bit-exactness across the resize comes from the layers below: batch
+order and RNG replay are mesh-independent (dataset position state +
+host-RNG snapshot in the checkpoint), and reductions use the same
+deterministic tree order regardless of device count — pinned by
+tests/test_elastic.py on 8→4 and 4→8 CPU meshes.
+
+HOST-ONLY CONTRACT (jaxlint JX5): jax is imported lazily inside the
+placement functions only.
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["describe_layout", "redistribute"]
+
+logger = logging.getLogger("bigdl_tpu.elastic")
+
+
+def describe_layout(layout) -> dict | None:
+    """Normalize a mesh descriptor to ``{axis_name: size}``. Accepts a
+    full manifest dict (unwraps its ``"mesh"`` key), a ``mesh_layout``
+    dict, or None (layout unknown — e.g. a pre-elastic checkpoint)."""
+    if layout is None:
+        return None
+    if "mesh" in layout and "axis_names" not in layout:
+        layout = layout["mesh"]
+    if layout is None:
+        return None
+    return {str(a): int(s) for a, s in
+            zip(layout["axis_names"], layout["axis_sizes"])}
+
+
+def _mesh_axes(mesh) -> dict:
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def redistribute(tree, src_layout, dst_mesh, *, shardings=None,
+                 what: str = "tree"):
+    """Place a host tree onto ``dst_mesh`` under ``shardings``.
+
+    ``src_layout`` is the saved mesh descriptor (manifest dict, layout
+    dict, or None); when it differs from the target mesh the resize is
+    logged. ``shardings`` is a single sharding applied to every leaf or
+    a matching tree of shardings; None means fully replicated."""
+    if tree is None:
+        return None
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    src = describe_layout(src_layout)
+    dst = _mesh_axes(dst_mesh)
+    if src is not None and src != dst:
+        logger.info("elastic resume: redistributing %s from mesh %s "
+                    "onto mesh %s", what, src, dst)
+    if shardings is None:
+        shardings = NamedSharding(dst_mesh, PartitionSpec())
+    if jax.process_count() <= 1:
+        # single controller: XLA slices the host array per device
+        return jax.device_put(tree, shardings)
+    # multi-process: every process holds the GLOBAL value (checkpoints
+    # store allgathered arrays), so build each jax.Array by handing XLA
+    # the numpy slice for whichever shard index it asks for
+    def place(leaf, sh):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx])
+
+    if hasattr(shardings, "device_set"):  # one sharding for every leaf
+        return jax.tree.map(lambda leaf: place(leaf, shardings), tree)
+    return jax.tree.map(place, tree, shardings)

@@ -272,6 +272,7 @@ class DistriOptimizer(Optimizer):
         model, criterion, optim = self.model, self.criterion, \
             self.optim_method
         mesh = self.mesh or get_mesh()
+        self._ckpt_mesh = mesh   # recorded in checkpoint manifests
         n_shards = int(np.prod(mesh.devices.shape))
         if self.tensor_parallel or self.shard_optim_state:
             # params/optimizer-state leaves carry mesh shardings on these
@@ -379,9 +380,20 @@ class DistriOptimizer(Optimizer):
             param_shard = su.params_sharding()
             opt_shard = su.opt_state_sharding(opt_state)
         else:
-            params = jax.device_put(params, param_shard)
-            mstate = jax.device_put(mstate, repl)
-            opt_state = jax.device_put(opt_state, opt_shard)
+            # mesh-portable placement (elastic/redistribute.py): the
+            # resumed host arrays land on THIS run's mesh whatever mesh
+            # they were saved under — 8 devices -> 4 is a resize, not an
+            # error (checkpoints hold host-global arrays, so this is
+            # placement, never a data transform)
+            from bigdl_tpu.elastic.redistribute import redistribute
+            src_layout = self.state.get("mesh_layout")
+            params = redistribute(params, src_layout, mesh,
+                                  shardings=param_shard, what="params")
+            mstate = redistribute(mstate, src_layout, mesh,
+                                  shardings=repl, what="model state")
+            opt_state = redistribute(opt_state, src_layout, mesh,
+                                     shardings=opt_shard,
+                                     what="optimizer state")
 
         use_mask = self._pad_stage is not None
         masked = None
@@ -612,6 +624,9 @@ class DistriOptimizer(Optimizer):
                 driver_state["neval"] += 1
                 if count_this_epoch >= epoch_size:
                     self._drain_pending(pending, driver_state, "epoch end")
+                    # epoch-end checkpoint barrier: pending async saves
+                    # commit before the next epoch dispatches
+                    self._ckpt_barrier()
                     driver_state["epoch"] += 1
                     driver_state["is_epoch_end"] = True
                     count_this_epoch = 0
@@ -657,6 +672,9 @@ class DistriOptimizer(Optimizer):
             pipeline.close()
 
         self._drain_pending(pending, driver_state, "training end")
+        # exit barrier: every handed-off checkpoint is committed (and any
+        # background save error raised) before optimize() returns
+        self._ckpt_shutdown(raise_errors=True)
         self._stop_profiler()
         self._publish_expert_telemetry(mstate)
         if su is not None:

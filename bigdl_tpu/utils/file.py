@@ -27,7 +27,8 @@ import zipfile
 import jax
 import numpy as np
 
-__all__ = ["save", "load", "save_module", "load_module"]
+__all__ = ["save", "load", "save_module", "load_module",
+           "ensure_writable_dir"]
 
 _MAGIC = "bigdl_tpu.v1"
 
@@ -106,6 +107,38 @@ def _open_write_atomic(path: str):
     os.replace(tmp, path)
 
 
+def ensure_writable_dir(path: str) -> None:
+    """Eagerly verify that ``path`` is (or can become) a writable
+    directory — the ``set_checkpoint`` guard that turns "training died
+    minutes in at the first trigger fire" into an immediate, clear
+    error. Creates the directory when absent; probes writability with a
+    scratch file on local filesystems (object stores have no cheap
+    probe — their makedirs is authoritative enough)."""
+    if _is_url(path):
+        try:
+            _fs_for(path).makedirs(path, exist_ok=True)
+        except Exception as e:
+            raise ValueError(
+                f"checkpoint path {path!r} is not usable: could not "
+                f"create the directory ({e})") from e
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        raise ValueError(
+            f"checkpoint path {path!r} is not a creatable directory "
+            f"({e}) — set_checkpoint needs a directory it can write "
+            "model/state/manifest files into") from e
+    probe = os.path.join(path, f".bigdl_tpu_write_probe_{os.getpid()}")
+    try:
+        with open(probe, "wb"):
+            pass
+        os.unlink(probe)
+    except OSError as e:
+        raise ValueError(
+            f"checkpoint path {path!r} is not writable ({e})") from e
+
+
 def _to_host(obj):
     """Replace jax arrays with numpy arrays throughout a pytree/object.
 
@@ -179,24 +212,33 @@ def _reset_grads(module) -> None:
         _reset_grads(child)
 
 
-def save_module(module, path: str, overwrite: bool = False) -> None:
+def save_module(module, path: str, overwrite: bool = False, *,
+                prepared: bool = False) -> None:
     """Persist a module with its params/state (reference
     AbstractModule.save, nn/abstractnn/AbstractModule.scala:305-310).
 
     The module object itself is pickled (topology + hyperparams) with its
     arrays moved to host memory, so ``load_module`` restores a working
     module without re-materialization.
+
+    ``prepared=True`` skips the clone/strip/host-copy pass: the caller
+    guarantees ``module`` is already a detached snapshot holding host
+    arrays only (the async checkpoint writer's path,
+    ``Optimizer._snapshot_module`` — the clone must happen on the
+    training thread, the pickling must not).
     """
     if _exists(path) and not overwrite:
         raise FileExistsError(f"{path} already exists")
-    module = module.clone_module()
-    _strip_runtime(module)
-    module.params = _to_host(module.params)
-    module.state = _to_host(module.state)
-    if module.params is not None:
-        # rebind children onto subtrees of the host copies — without this
-        # the pickle stores a second (device-array) copy per child
-        module.sync(module.params, module.state)
+    if not prepared:
+        module = module.clone_module()
+        _strip_runtime(module)
+        module.params = _to_host(module.params)
+        module.state = _to_host(module.state)
+        if module.params is not None:
+            # rebind children onto subtrees of the host copies — without
+            # this the pickle stores a second (device-array) copy per
+            # child
+            module.sync(module.params, module.state)
     with _open_write_atomic(path) as f:
         pickle.dump((_MAGIC, module), f,
                     protocol=pickle.HIGHEST_PROTOCOL)

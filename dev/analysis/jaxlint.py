@@ -69,10 +69,14 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # tuning/: records/search/cache bookkeeping is host-side; the
 # measurement and lower/compile/serialize calls lazy-import jax inside
 # the functions that issue them
+# elastic/: manifests, the checkpoint writer thread, and the restart
+# runner are host machinery (the runner must not even initialize a
+# backend); snapshot/placement calls lazy-import jax where issued
 HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
                       "bigdl_tpu/dataset/prefetch.py",
                       "bigdl_tpu/serving/",
-                      "bigdl_tpu/tuning/")
+                      "bigdl_tpu/tuning/",
+                      "bigdl_tpu/elastic/")
 
 # the per-iteration-sync flavor of JX1 only applies to library code:
 # tests and dev tooling are host drivers that sync deliberately

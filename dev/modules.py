@@ -33,6 +33,7 @@ MODULES = {
     "observability": ["tests/test_observability.py",
                       "tests/test_telemetry.py"],
     "tuning": ["tests/test_tuning.py"],
+    "elastic": ["tests/test_elastic.py"],
     "serving": ["tests/test_serving_router.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],

@@ -334,6 +334,7 @@ class TestBenchRecovery:
     structured rows + postmortem, not a raw rc=1 traceback) and round-5
     (probe failure dumps a flight-recorder postmortem)."""
 
+    @pytest.mark.slow  # full inception trace is ~15s on the tier-1 box
     def test_inception_step_traces_on_cpu(self):
         """Regression for the BENCH_r04 crash signature: the inception
         row's train step TRACES cleanly on CPU — the
@@ -1042,3 +1043,56 @@ class TestPipelineBubbleRow:
         for name in ("gpipe", "1f1b", "interleaved_1f1b"):
             assert row[f"measured_{name}"] == pytest.approx(
                 row[f"modeled_{name}"], abs=0.1)
+
+
+class TestElasticResumeRow:
+    """ISSUE 14 satellite: elastic_resume_secs — SIGKILL a checkpointing
+    trainer, resume on a resized mesh from the latest manifest, warm AOT
+    cache — rides the standard row/known/all contract."""
+
+    FAKE = {"metric": "elastic_resume_secs", "value": 1.75,
+            "unit": "s (kill -> first resumed step, warm AOT cache, "
+                    "8->4 mesh)",
+            "cold_resume_s": 4.2, "warm_resume_s": 1.75,
+            "load_s": 0.3, "resumed_neval": 8, "warm_cache_hits": 1,
+            "warm_cache_misses": 0, "loss_bit_identical": True}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_elastic_resume_secs",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "elastic_resume_secs",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "elastic_resume_secs"
+        assert lines[-1]["rows"][0]["value"] == 1.75
+        with open(out) as f:
+            assert "bench_elastic_resume_secs 1.75" in f.read()
+
+    def test_row_in_all(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "elastic_resume_secs" in [r["metric"]
+                                         for r in agg["rows"]]
+
+    @pytest.mark.slow
+    def test_real_probe_kill_and_resume(self, tmp_path):
+        """A REAL kill-and-resume: the trainer is SIGKILLed mid-run
+        after its first manifest commits, both resume subprocesses land
+        on the 4-device mesh from the same snapshot (bit-identical first
+        loss), and the warm one loads its executable from the cache."""
+        row = bench.bench_elastic_resume_secs(
+            train_devices=8, resume_devices=4,
+            ckpt_dir=str(tmp_path / "ck"))
+        assert row["metric"] == "elastic_resume_secs"
+        assert row["value"] > 0
+        assert row["resumed_neval"] >= 8
+        assert row["warm_cache_hits"] >= 1
+        assert row["warm_cache_misses"] == 0
+        assert row["loss_bit_identical"] is True

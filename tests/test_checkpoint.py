@@ -232,3 +232,85 @@ class TestRemoteCheckpointIO:
         x = np.random.RandomState(2).rand(4, 2).astype(np.float32)
         np.testing.assert_array_equal(np.asarray(m_remote.forward(x)),
                                       np.asarray(m_local.forward(x)))
+
+
+class _PoisonPickle:
+    """A leaf whose serialization fails midway — simulates a crash
+    inside the write (full disk, OOM in pickling, SIGKILL landing
+    between bytes)."""
+
+    def __reduce__(self):
+        raise OSError("simulated crash mid-serialization")
+
+
+class TestAtomicSaves:
+    """Saves stage to a sibling ``.tmp`` and rename into place: a save
+    that dies midway must leave the previous checkpoint intact and no
+    torn/temp files behind (utils/file.py _open_write_atomic)."""
+
+    def test_failed_save_preserves_previous_file(self, tmp_path):
+        path = str(tmp_path / "state.4")
+        good = {"w": np.arange(4, dtype=np.float32), "epoch": 2}
+        bfile.save(good, path)
+        with pytest.raises(OSError, match="mid-serialization"):
+            bfile.save({"w": np.zeros(4), "bad": _PoisonPickle()},
+                       path, overwrite=True)
+        back = bfile.load(path)
+        np.testing.assert_array_equal(back["w"], good["w"])
+        assert back["epoch"] == 2
+        import os
+        assert sorted(os.listdir(tmp_path)) == ["state.4"], \
+            "a failed save leaked temp files"
+
+    def test_failed_url_save_preserves_previous_object(self):
+        fsspec = pytest.importorskip("fsspec")
+        from fsspec.implementations.memory import MemoryFileSystem
+        MemoryFileSystem.store.clear()
+        url = "memory://atomic/state.4"
+        good = {"w": np.arange(3, dtype=np.float32)}
+        bfile.save(good, url)
+        with pytest.raises(OSError, match="mid-serialization"):
+            bfile.save({"bad": _PoisonPickle()}, url, overwrite=True)
+        back = bfile.load(url)
+        np.testing.assert_array_equal(back["w"], good["w"])
+        fs, _ = fsspec.core.url_to_fs(url)
+        names = [n.rsplit("/", 1)[-1]
+                 for n in fs.ls("memory://atomic", detail=False)]
+        assert names == ["state.4"], "a failed save leaked temp objects"
+
+
+class TestOverwriteCheckpointSemantics:
+    """overwrite_checkpoint() pins the reference Optimizer.overWriteCheckpoint
+    behaviour: one suffix-less snapshot replaced in place, vs the default
+    accumulating model.N/state.N history."""
+
+    def _run(self, ck, overwrite):
+        RandomGenerator.set_seed(11)
+        model = make_model()
+        ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_checkpoint(str(ck), optim.several_iteration(4))
+        if overwrite:
+            o.overwrite_checkpoint()
+        o.set_end_when(optim.max_iteration(8))
+        o.optimize()
+
+    def test_default_accumulates_history(self, tmp_path):
+        self._run(tmp_path, overwrite=False)
+        import os
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["manifest.4.json", "manifest.8.json",
+                         "model.4", "model.8", "state.4", "state.8"]
+
+    def test_overwrite_keeps_single_replaced_snapshot(self, tmp_path):
+        self._run(tmp_path, overwrite=True)
+        import os
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["manifest.json", "model", "state"]
+        # both fires landed on the same names; the survivor is the last
+        from bigdl_tpu import elastic
+        man = elastic.latest_checkpoint(str(tmp_path))
+        assert man["neval"] == 8
+        assert int(np.asarray(bfile.load(
+            f"{tmp_path}/state")["neval"])) == 8

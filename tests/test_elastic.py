@@ -1,0 +1,543 @@
+"""Elastic training subsystem tests (bigdl_tpu/elastic/, ISSUE 14).
+
+The properties under test, in order of ambition:
+
+1. MESH-PORTABLE RESUME — a run checkpointed on an N-device mesh and
+   resumed on an M-device mesh replays the uninterrupted loss series
+   BIT-identically (8→4 and 4→8, replicated and sharded-update): the
+   checkpoint holds host-global arrays + a mesh descriptor, and
+   ``redistribute`` makes placement a resume-time choice.
+2. ASYNC == SYNC — the background CheckpointWriter commits checkpoints
+   byte-equivalent in content to the synchronous save, with the
+   save-overhead receipt showing real work moved off the critical path.
+3. DETECT-AND-RESTART — ElasticRunner turns a dead/wedged child into a
+   postmortem + resume-from-latest-manifest, pinned with scripted fakes
+   (fast) and a real kill-mid-epoch subprocess drill (slow-marked).
+"""
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import elastic
+from bigdl_tpu.dataset import Sample, array, SampleToBatch
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    Engine.reset()
+    yield
+    Engine.reset()
+
+
+def make_dataset(n=128, num_shards=None):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+    return array([Sample(x[i], y[i]) for i in range(n)],
+                 num_shards=num_shards)
+
+
+def make_model():
+    return nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Dropout(0.2),
+                         nn.Linear(16, 2), nn.LogSoftMax())
+
+
+class _LossRecorder(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "loss is" in msg:
+            self.losses.append(float(
+                msg.split("loss is ")[1].split(",")[0]))
+
+
+def _run_mesh(ndev, iters, ckpt_dir=None, ckpt_every=None, resume=False,
+              sharded=False):
+    """One distri training run on an ndev-device sub-mesh; returns the
+    per-iteration loss series (and the optimizer, for receipts)."""
+    import jax
+    RandomGenerator.set_seed(5)
+    rec = _LossRecorder()
+    logger = logging.getLogger("bigdl_tpu.optim")
+    logger.addHandler(rec)
+    logger.setLevel(logging.INFO)
+    try:
+        Engine.reset()
+        Engine.init(axes={"data": ndev}, devices=jax.devices()[:ndev])
+        ds = make_dataset(num_shards=1) >> SampleToBatch(
+            16, drop_remainder=True)
+        if resume:
+            model, state, man = elastic.load_checkpoint(ckpt_dir)
+            assert int(man["neval"]) == 8
+        else:
+            model, state = make_model(), None
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+        if sharded:
+            o.set_sharded_update(True)
+        if state is not None:
+            o.set_state(state)
+        if ckpt_every is not None:
+            o.set_checkpoint(str(ckpt_dir),
+                             optim.several_iteration(ckpt_every))
+        o.set_end_when(optim.max_iteration(iters))
+        o.optimize()
+    finally:
+        logger.removeHandler(rec)
+    return rec.losses, o
+
+
+class TestMeshPortableResume:
+    """Acceptance criterion: resume on a DIFFERENT device count replays
+    the source run's loss series bit-identically — replicated and
+    sharded-update (``set_sharded_update(True)``) runs, both resize
+    directions. The source run itself proves checkpoint-at-8 does not
+    perturb training (it runs to 12 uninterrupted); the resumed run
+    must reproduce its tail EXACTLY (np.testing.assert_array_equal, not
+    allclose — the empirical basis: CPU-mesh reductions are
+    device-count-invariant here)."""
+
+    @pytest.mark.parametrize("sharded", [False, True],
+                             ids=["replicated", "sharded-update"])
+    @pytest.mark.parametrize("src_dev,dst_dev", [(8, 4), (4, 8)],
+                             ids=["8to4", "4to8"])
+    def test_resize_replays_bit_identically(self, tmp_path, sharded,
+                                            src_dev, dst_dev):
+        # several_iteration(8) fires at post-increment neval 8 — after 7
+        # completed steps, MID-epoch (8 batches/epoch) — so the resumed
+        # run exercises data-position + host-RNG replay too
+        src, _ = _run_mesh(src_dev, 12, ckpt_dir=tmp_path, ckpt_every=8,
+                           sharded=sharded)
+        assert len(src) == 12
+        man = elastic.latest_checkpoint(str(tmp_path))
+        assert man is not None and int(man["neval"]) == 8
+        assert man["mesh"]["axis_sizes"] == [src_dev]
+        resumed, _ = _run_mesh(dst_dev, 12, ckpt_dir=tmp_path,
+                               resume=True, sharded=sharded)
+        assert len(resumed) == 5
+        np.testing.assert_array_equal(np.asarray(resumed),
+                                      np.asarray(src)[7:])
+
+
+class TestAsyncCheckpointing:
+    def _run_local(self, ckpt_dir, *, async_save, iters=6, every=4):
+        RandomGenerator.set_seed(9)
+        model = make_model()
+        ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+        o.set_checkpoint(str(ckpt_dir), optim.several_iteration(every),
+                         async_save=async_save)
+        o.set_end_when(optim.max_iteration(iters))
+        o.optimize()
+        return o
+
+    def test_async_checkpoint_identical_to_sync(self, tmp_path):
+        """The async writer must change WHEN serialization happens, not
+        WHAT lands on disk: same seeded recipe, async vs sync, and the
+        loaded modules/states/manifests match array-exactly."""
+        self._run_local(tmp_path / "a", async_save=True)
+        self._run_local(tmp_path / "s", async_save=False)
+        ma, sa, mana = elastic.load_checkpoint(str(tmp_path / "a"))
+        ms, ss, mans = elastic.load_checkpoint(str(tmp_path / "s"))
+        assert mana == mans
+        import jax
+        for la, ls in zip(jax.tree.leaves(ma.params),
+                          jax.tree.leaves(ms.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(ls))
+        assert set(sa) == set(ss)
+        for k in sa:
+            for la, ls in zip(jax.tree.leaves(sa[k]),
+                              jax.tree.leaves(ss[k])):
+                if isinstance(la, (bytes, str)):
+                    assert la == ls
+                else:
+                    np.testing.assert_array_equal(np.asarray(la),
+                                                  np.asarray(ls))
+
+    def test_save_overhead_receipt(self, tmp_path):
+        """The elastic_ckpt_save_overhead receipt: serialization cost
+        moved to the worker, the critical path paid only the handoff."""
+        o = self._run_local(tmp_path, async_save=True)
+        r = o.checkpoint_receipt
+        assert r is not None and r["saves"] == 1
+        assert r["write_s"] > 0 and r["handoff_s"] > 0
+        assert 0 < r["off_critical_path_fraction"] <= 1
+        assert o.metrics.stats("checkpoint handoff time")["n"] == 1
+        from bigdl_tpu.observability.registry import default_registry
+        text = default_registry().expose()
+        assert "elastic_ckpt_pending" in text
+        assert "elastic_ckpt_saves_total" in text
+        assert "elastic_ckpt_save_overhead" in text
+
+    def test_background_save_error_fails_the_run(self, tmp_path):
+        """A checkpoint that fails in the background must fail
+        optimize() — a run must not outlive its last good snapshot
+        silently."""
+        o = self._run_local(tmp_path, async_save=True, iters=2, every=10)
+        w = o._ckpt_writer_get()
+        w.submit(lambda: (_ for _ in ()).throw(OSError("disk full")),
+                 label="doomed")
+        with pytest.raises(RuntimeError, match="background"):
+            w.close()
+
+    def test_writer_runs_jobs_in_order_and_drains_on_close(self):
+        from bigdl_tpu.elastic.checkpoint_writer import CheckpointWriter
+        ran = []
+        with CheckpointWriter(name="unit", depth=2) as w:
+            for i in range(5):
+                w.submit(lambda i=i: ran.append(i), label=str(i))
+            w.barrier()
+            assert ran == [0, 1, 2, 3, 4]
+        assert w.receipt()["saves"] == 5
+        with pytest.raises(RuntimeError, match="closed"):
+            w.submit(lambda: None)
+
+
+class TestManifestFormat:
+    def test_roundtrip_and_latest(self, tmp_path):
+        params = {"w": np.zeros((3, 2), np.float32),
+                  "b": np.zeros((2,), np.float32)}
+        for neval in (4, 12, 8):
+            man = elastic.build_manifest(
+                neval=neval, epoch=1, model_file=f"model.{neval}",
+                state_file=f"state.{neval}", params=params)
+            elastic.write_manifest(
+                man, str(tmp_path / elastic.manifest_name(f".{neval}")))
+        latest = elastic.latest_checkpoint(str(tmp_path))
+        assert latest["neval"] == 12 and latest["model"] == "model.12"
+        back = elastic.read_manifest(
+            str(tmp_path / "manifest.8.json"))
+        assert back["params"]["['w']"] == {"shape": [3, 2],
+                                           "dtype": "float32"}
+
+    def test_latest_skips_torn_manifest(self, tmp_path):
+        man = elastic.build_manifest(neval=3, epoch=1, model_file="m",
+                                     state_file="s")
+        elastic.write_manifest(man,
+                               str(tmp_path / elastic.manifest_name(".3")))
+        # a torn/garbage manifest (e.g. truncated by a crash before the
+        # atomic-rename discipline existed) must be skipped, not fatal
+        (tmp_path / "manifest.9.json").write_text("{not json")
+        latest = elastic.latest_checkpoint(str(tmp_path))
+        assert latest["neval"] == 3
+
+    def test_empty_and_missing_dir(self, tmp_path):
+        assert elastic.latest_checkpoint(str(tmp_path)) is None
+        assert elastic.latest_checkpoint(
+            str(tmp_path / "nowhere")) is None
+        with pytest.raises(FileNotFoundError, match="nothing to resume"):
+            elastic.load_checkpoint(str(tmp_path))
+
+    def test_newer_version_refused(self, tmp_path):
+        man = elastic.build_manifest(neval=1, epoch=1, model_file="m",
+                                     state_file="s")
+        man["version"] = elastic.MANIFEST_VERSION + 1
+        p = str(tmp_path / "manifest.1.json")
+        elastic.write_manifest(man, p)
+        with pytest.raises(ValueError, match="newer"):
+            elastic.read_manifest(p)
+
+    def test_validate_tree_catches_drift(self):
+        params = {"w": np.zeros((3, 2), np.float32)}
+        man = elastic.build_manifest(neval=1, epoch=1, model_file="m",
+                                     state_file="s", params=params)
+        elastic.validate_tree(params, man["params"], "params")  # clean
+        with pytest.raises(ValueError, match="params"):
+            elastic.validate_tree({"w": np.zeros((3, 3), np.float32)},
+                                  man["params"], "params")
+        with pytest.raises(ValueError, match="missing"):
+            elastic.validate_tree({}, man["params"], "params")
+
+
+class TestRedistribute:
+    def test_place_host_tree_on_submesh(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        Engine.init(axes={"data": 4}, devices=jax.devices()[:4])
+        from bigdl_tpu.parallel.engine import get_mesh
+        mesh = get_mesh()
+        tree = {"w": np.arange(12, dtype=np.float32).reshape(4, 3),
+                "b": np.ones((3,), np.float32)}
+        src = {"axis_names": ["data"], "axis_sizes": [8],
+               "device_kinds": ["cpu"]}
+        placed = jax.tree.map(lambda x: x, elastic.redistribute(
+            tree, src, mesh))
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(placed[k]), tree[k])
+        assert placed["w"].sharding.mesh.shape["data"] == 4
+        # batch-style sharding over the new axis size
+        sh = NamedSharding(mesh, PartitionSpec("data"))
+        placed_w = elastic.redistribute(tree["w"], src, mesh,
+                                        shardings=sh, what="batch")
+        np.testing.assert_array_equal(np.asarray(placed_w), tree["w"])
+        assert elastic.redistribute(None, src, mesh) is None
+
+    def test_describe_layout(self):
+        lay = {"axis_names": ["data", "model"], "axis_sizes": [4, 2],
+               "device_kinds": ["cpu"]}
+        assert elastic.describe_layout(lay) == {"data": 4, "model": 2}
+        assert elastic.describe_layout({"mesh": lay, "axis_nope": 1}) \
+            == {"data": 4, "model": 2}
+        assert elastic.describe_layout(None) is None
+        assert elastic.describe_layout({"mesh": None, "neval": 3}) is None
+
+
+class TestSetCheckpointValidation:
+    def test_unwritable_path_fails_eagerly(self, tmp_path):
+        """A bad checkpoint path must fail AT set_checkpoint, not
+        minutes later at the first trigger fire."""
+        blocker = tmp_path / "iamafile"
+        blocker.write_text("x")
+        o = optim.Optimizer(
+            model=make_model(),
+            dataset=make_dataset() >> SampleToBatch(16),
+            criterion=nn.ClassNLLCriterion())
+        with pytest.raises(ValueError, match="checkpoint path"):
+            o.set_checkpoint(str(blocker / "sub"),
+                             optim.several_iteration(1))
+
+    def test_valid_path_is_created(self, tmp_path):
+        o = optim.Optimizer(
+            model=make_model(),
+            dataset=make_dataset() >> SampleToBatch(16),
+            criterion=nn.ClassNLLCriterion())
+        target = tmp_path / "new" / "ckpts"
+        o.set_checkpoint(str(target), optim.several_iteration(1))
+        assert target.is_dir()
+        assert o.checkpoint_path == str(target)
+
+
+class _FakeChild:
+    """Scripted child handle: a poll script of None (running) /int (exit
+    code) entries; records kill()."""
+
+    def __init__(self, polls):
+        self._polls = list(polls)
+        self.pid = 4242
+        self.killed = False
+
+    def poll(self):
+        if len(self._polls) > 1:
+            return self._polls.pop(0)
+        return self._polls[0]
+
+    def kill(self):
+        self.killed = True
+
+
+class TestElasticRunner:
+    def test_restarts_dead_child_and_resumes_from_manifest(self, tmp_path):
+        man = elastic.build_manifest(neval=7, epoch=2, model_file="m",
+                                     state_file="s")
+        elastic.write_manifest(
+            man, str(tmp_path / elastic.manifest_name(".7")))
+        children = [_FakeChild([None, 3]), _FakeChild([None, 0])]
+        seen = []
+
+        def spawn(resume, attempt):
+            seen.append((None if resume is None else resume["neval"],
+                         attempt))
+            return children[attempt - 1]
+
+        runner = elastic.ElasticRunner(
+            spawn, str(tmp_path), max_restarts=2, poll_interval=0.01,
+            postmortem_dir=str(tmp_path / "pm"))
+        out = runner.run()
+        assert out["rc"] == 0 and out["restarts"] == 1
+        # both attempts resumed from the pre-existing manifest
+        assert seen == [(7, 1), (7, 2)]
+        assert out["resumed_from"] == [7, 7]
+        # the failed attempt left a flight-recorder postmortem
+        assert len(out["postmortems"]) == 1
+        assert os.path.isfile(os.path.join(out["postmortems"][0],
+                                           "exception.json"))
+
+    def test_wedged_child_is_killed_on_liveness_failure(self, tmp_path):
+        probes = iter([(True, "ok"), (None, "unreachable"),
+                       (False, "last step 9.9s ago")])
+        children = [_FakeChild([None]), _FakeChild([0])]
+
+        def spawn(resume, attempt):
+            return children[attempt - 1]
+
+        runner = elastic.ElasticRunner(
+            spawn, str(tmp_path), max_restarts=1, poll_interval=0.01,
+            liveness=lambda: next(probes),
+            postmortem_dir=str(tmp_path / "pm"))
+        out = runner.run()
+        assert children[0].killed
+        assert out["restarts"] == 1
+        assert out["resumed_from"] == [None, None]
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        def spawn(resume, attempt):
+            return _FakeChild([5])
+
+        runner = elastic.ElasticRunner(
+            spawn, str(tmp_path), max_restarts=1, poll_interval=0.01,
+            postmortem_dir=str(tmp_path / "pm"))
+        with pytest.raises(RuntimeError, match="giving up after 1"):
+            runner.run()
+        # every failed attempt (initial + restart) left a postmortem
+        assert os.path.isdir(str(tmp_path / "pm" / "attempt1"))
+        assert os.path.isdir(str(tmp_path / "pm" / "attempt2"))
+
+    def test_probe_liveness_semantics(self):
+        ok, _ = elastic.probe_liveness("http://127.0.0.1:1",
+                                       timeout=0.2)
+        assert ok is None  # unreachable = unknown, not wedged
+
+
+_DRILL_CHILD = """
+import json, logging, os, sys, time
+import numpy as np
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import elastic
+from bigdl_tpu.dataset import Sample, array, SampleToBatch
+from bigdl_tpu.utils.random import RandomGenerator
+
+ckpt_dir, port_file, losses_file = sys.argv[1:4]
+wedge = os.environ.get("DRILL_WEDGE") == "1"
+
+RandomGenerator.set_seed(5)
+rs = np.random.RandomState(0)
+x = rs.rand(128, 2).astype(np.float32)
+y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64) + 1
+ds = array([Sample(x[i], y[i]) for i in range(128)]) \
+    >> SampleToBatch(16, drop_remainder=True)
+
+if elastic.latest_checkpoint(ckpt_dir) is not None:
+    model, state, _ = elastic.load_checkpoint(ckpt_dir)
+else:
+    model, state = nn.Sequential(
+        nn.Linear(2, 16), nn.Tanh(), nn.Dropout(0.2), nn.Linear(16, 2),
+        nn.LogSoftMax()), None
+
+o = optim.Optimizer(model=model, dataset=ds,
+                    criterion=nn.ClassNLLCriterion())
+o.set_optim_method(optim.SGD(learning_rate=0.3, momentum=0.9))
+if state is not None:
+    o.set_state(state)
+o.set_checkpoint(ckpt_dir, optim.several_iteration(8))
+o.set_metrics_server(port=0, liveness_deadline=1.0)
+
+wrote_port = []
+
+def end_when(state):
+    if not wrote_port and o._metrics_server is not None:
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(o._metrics_server.port))
+        os.replace(tmp, port_file)
+        wrote_port.append(True)
+    if wedge and state["neval"] > 10:
+        time.sleep(600)     # a wedged backend: alive by PID, no progress
+    return state["neval"] > 12
+
+o.set_end_when(end_when)
+
+losses = []
+class Rec(logging.Handler):
+    def emit(self, record):
+        msg = record.getMessage()
+        if "loss is" in msg:
+            losses.append(float(msg.split("loss is ")[1].split(",")[0]))
+
+lg = logging.getLogger("bigdl_tpu.optim")
+lg.addHandler(Rec())
+lg.setLevel(logging.INFO)
+o.optimize()
+with open(losses_file, "a") as f:
+    for l in losses:
+        f.write(json.dumps(l) + "\\n")
+"""
+
+
+@pytest.mark.slow
+class TestKillMidEpochDrill:
+    """The end-to-end acceptance drill: a real training subprocess
+    wedges mid-epoch past its liveness deadline; the runner detects the
+    503, dumps a postmortem, kills it, and respawns — the second
+    attempt resumes from the manifest and its losses match the
+    uninterrupted run's tail bit-identically."""
+
+    def _spawn_child(self, script, ckpt, port_file, losses, wedge):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+                   DRILL_WEDGE="1" if wedge else "0")
+        env.pop("XLA_FLAGS", None)
+        return elastic.ProcessChild(
+            [sys.executable, script, str(ckpt), str(port_file),
+             str(losses)],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def test_drill(self, tmp_path):
+        script = tmp_path / "drill_child.py"
+        script.write_text(_DRILL_CHILD)
+
+        # the uninterrupted reference run (no wedge, own directories)
+        ref_losses = tmp_path / "ref_losses.jsonl"
+        child = self._spawn_child(script, tmp_path / "ref_ck",
+                                  tmp_path / "ref_port", ref_losses,
+                                  wedge=False)
+        assert child._proc.wait(timeout=240) == 0
+        ref = [json.loads(l) for l in
+               ref_losses.read_text().splitlines()]
+        assert len(ref) == 12
+
+        # the drill: attempt 1 wedges after the neval-8 checkpoint
+        ckpt = tmp_path / "ck"
+        losses = tmp_path / "losses.jsonl"
+        attempts = []
+
+        def spawn(resume, attempt):
+            attempts.append(None if resume is None
+                            else int(resume["neval"]))
+            port_file = tmp_path / f"port.{attempt}"
+            return self._spawn_child(script, ckpt, port_file, losses,
+                                     wedge=(attempt == 1))
+
+        def liveness():
+            port_file = tmp_path / f"port.{len(attempts)}"
+            if not port_file.exists():
+                return None, "metrics port not up yet"
+            return elastic.probe_liveness(
+                f"http://127.0.0.1:{port_file.read_text().strip()}")
+
+        runner = elastic.ElasticRunner(
+            spawn, str(ckpt), max_restarts=2, poll_interval=0.25,
+            liveness=liveness, postmortem_dir=str(tmp_path / "pm"))
+        out = runner.run()
+        assert out["rc"] == 0 and out["restarts"] == 1
+        assert attempts == [None, 8]
+        # postmortem evidence for the wedged attempt
+        assert os.path.isfile(os.path.join(out["postmortems"][0],
+                                           "exception.json"))
+        with open(os.path.join(out["postmortems"][0],
+                               "exception.json")) as f:
+            assert "wedged" in json.dumps(json.load(f))
+        # attempt 1 logged >= 10 losses before wedging; attempt 2
+        # resumed from neval 8 (7 completed steps) and ran 5 more —
+        # bit-identical to the uninterrupted run's tail
+        all_losses = [json.loads(l) for l in
+                      losses.read_text().splitlines()]
+        resumed = all_losses[-5:]
+        np.testing.assert_array_equal(np.asarray(resumed),
+                                      np.asarray(ref)[7:])

@@ -444,6 +444,32 @@ class TestJX5HostOnlyImports:
         """, rel="bigdl_tpu/tuning/aot_cache.py")
         assert out == []
 
+    def test_elastic_subsystem_is_host_only(self):
+        """ISSUE 14 satellite pin: bigdl_tpu/elastic/ (manifests, async
+        checkpoint writer, restart runner) is host machinery — a
+        module-level jax import in any of its modules is a JX5 finding
+        (snapshot/placement calls lazy-import jax where issued; the
+        ElasticRunner must stay importable without a backend), and the
+        shipped files are clean."""
+        for mod in ("__init__.py", "manifest.py", "checkpoint_writer.py",
+                    "redistribute.py", "runner.py"):
+            rel = f"bigdl_tpu/elastic/{mod}"
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, "bigdl_tpu", "elastic", mod)
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+        # the sanctioned lazy-import snapshot shape stays clean
+        out = lint("""
+            def snapshot_to_host(tree):
+                import jax
+                return jax.device_get(tree)
+        """, rel="bigdl_tpu/elastic/checkpoint_writer.py")
+        assert out == []
+
     def test_telemetry_plane_modules_are_covered(self):
         """Satellite pin: the host-only prefix covers the telemetry
         plane — a module-level jax import in exporter.py /

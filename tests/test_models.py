@@ -8,6 +8,7 @@ marked slow-ish but still CPU-feasible.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bigdl_tpu import models
 from bigdl_tpu.nn import ClassNLLCriterion
@@ -97,6 +98,7 @@ class TestVgg:
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 32, 32))
         assert fwd(models.VggForCifar10(10), x).shape == (2, 10)
 
+    @pytest.mark.slow  # 224x224 vgg16 compile ~13s; cifar pins the family
     def test_vgg16_shape(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
         assert fwd(models.Vgg_16(10), x).shape == (1, 10)

@@ -60,6 +60,9 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    # grad-of-ring compiles ~70s total on the single-core tier-1 box;
+    # forward parity above keeps the ring core pinned in tier-1
+    @pytest.mark.slow
     @pytest.mark.parametrize("causal", [False, True])
     def test_gradients_match_local(self, causal):
         mesh = _seq_mesh()
@@ -187,7 +190,10 @@ class TestMultiHeadAttentionModule:
         assert all(np.isfinite(np.asarray(l)).all()
                    for l in jax.tree.leaves(g))
 
-    @pytest.mark.parametrize("sp", ["ring", "ulysses"])
+    # the ring variant re-traces per hop (~14s); ulysses keeps the
+    # module-level sequence-parallel seam in tier-1
+    @pytest.mark.parametrize(
+        "sp", [pytest.param("ring", marks=pytest.mark.slow), "ulysses"])
     def test_sequence_parallel_matches_local(self, sp):
         mesh = _seq_mesh()
         local = nn.MultiHeadAttention(32, 8, causal=True)
