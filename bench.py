@@ -1531,7 +1531,146 @@ def bench_serving_decode_hbm(**geometry):
         # executable numbers describe the emulation; the static rows
         # above are the backend-independent receipt
         "paged_compiled_as": payload["paged_compiled_as"],
+        # int8 quantized serving (serving/quantized.py): resident
+        # weight + KV-pool argument bytes, fp32 vs int8-at-rest
+        "int8_weight_kv_bytes_fp32":
+            payload["int8"]["weight_kv_bytes_fp32"],
+        "int8_weight_kv_bytes_int8":
+            payload["int8"]["weight_kv_bytes_int8"],
+        "int8_kv_pool_bytes_fp32": payload["int8"]["kv_pool_bytes_fp32"],
+        "int8_kv_pool_bytes_int8": payload["int8"]["kv_pool_bytes_int8"],
+        "int8_reduction": round(payload["int8"]["reduction"], 2),
         "geometry": payload["geometry"],
+    }
+
+
+def _autoscale_drill(model, cache_dir, *, prompts, geo, slo, cfg,
+                     target_replicas):
+    """One autoscaler spin-up drill (ISSUE 15): a 1-replica AOT-cached
+    pool behind a Router + Autoscaler, hit with a synthetic admission
+    spike; the closed loop runs until the fleet reaches
+    ``target_replicas``. Returns time-to-capacity plus the AOT cache
+    counters (the warm-vs-cold receipt) and the conservation check."""
+    from bigdl_tpu.observability.exporter import HealthRegistry
+    from bigdl_tpu.observability.registry import MetricRegistry
+    from bigdl_tpu.serving import (Autoscaler, ReplicaPool, Router)
+
+    health = HealthRegistry()
+    pool = ReplicaPool(model, 1, health=health, start=False,
+                       aot_cache=cache_dir, **geo)
+    t0 = time.perf_counter()
+    pool["r0"].batcher.warmup(prompt_buckets=(16,))
+    first_spinup_s = time.perf_counter() - t0
+    pool.start()
+    router = Router(pool, slo=slo, health=health,
+                    registry=MetricRegistry(), capture_prefixes=False)
+    asc = Autoscaler(router, config=cfg, registry=MetricRegistry())
+    try:
+        t_spike = time.perf_counter()
+        for i, p in enumerate(prompts):
+            router.submit(f"q{i}", p)
+        t_capacity = None
+        while time.perf_counter() - t_spike < 300:
+            asc.evaluate()
+            if len(pool) >= target_replicas:
+                t_capacity = time.perf_counter() - t_spike
+                break
+            time.sleep(0.01)
+        if t_capacity is None:
+            raise RuntimeError(
+                f"fleet never reached {target_replicas} replicas "
+                f"(pending={router.pending_count})")
+        router.wait_all(timeout=600)
+        results = dict(router.finished())
+        # quiet period: hysteresis retires the spike capacity via
+        # drain/migrate (conservation across scale-down is the
+        # wait_all/finished accounting above plus the late stragglers)
+        scale_downs = 0
+        for _ in range(cfg.hysteresis_evals * (cfg.cooldown_evals + 1)
+                       + 12):
+            if asc.evaluate().action == "down":
+                scale_downs += 1
+            if len(pool) <= cfg.min_replicas:
+                break
+        results.update(router.finished())
+    finally:
+        router.close()
+        pool.close()
+    if len(results) != len(prompts):
+        raise RuntimeError(f"autoscale drill dropped/duplicated work: "
+                           f"{len(results)} results for "
+                           f"{len(prompts)} requests")
+    return {
+        "time_to_capacity_s": t_capacity,
+        "first_spinup_s": first_spinup_s,
+        "aot_hits": pool.aot.hits, "aot_misses": pool.aot.misses,
+        "replicas_peak": max(target_replicas, len(pool)),
+        "scale_downs": scale_downs,
+        "n_results": len(results),
+    }
+
+
+def bench_autoscale_time_to_capacity(*, n_requests: int = 24,
+                                     target_replicas: int = 3):
+    """Fleet autoscaler receipt (ISSUE 15): seconds from a synthetic
+    admission spike against a 1-replica pool until the closed loop has
+    scaled the fleet to ``target_replicas``, warm vs cold AOT
+    executable cache. The drill runs twice over ONE cache directory:
+    the cold pass pays every prefill/decode compile; the warm pass is a
+    fresh pool + compiler table over the same directory — the PR 8
+    warm-restart machinery as time-to-capacity — and must report ZERO
+    cache misses (every spin-up deserializes stored executables).
+    ``value`` is the warm time-to-capacity (lower is better)."""
+    import tempfile
+
+    import jax
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.serving import AutoscalerConfig, SLOConfig
+
+    vocab = 256
+    model = TransformerLM(vocab, d_model=64, num_heads=4, num_layers=2,
+                          max_len=64, with_log_softmax=False)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    host = np.random.default_rng(0)
+    prompts = [list(host.integers(1, vocab + 1,
+                                  size=(int(host.integers(5, 14)),)))
+               for _ in range(n_requests)]
+    geo = dict(max_batch=2, num_pages=64, page_size=4,
+               max_new_tokens=8, max_burst=4)
+    slo = SLOConfig(long_prefill_tokens=64, max_queue_depth=2)
+    cfg = AutoscalerConfig(min_replicas=1, max_replicas=target_replicas,
+                           pending_per_replica=2, hysteresis_evals=2,
+                           cooldown_evals=0, interval_s=0.05)
+    with tempfile.TemporaryDirectory() as cache_dir:
+        drill = dict(prompts=prompts, geo=geo, slo=slo, cfg=cfg,
+                     target_replicas=target_replicas)
+        cold = _autoscale_drill(model, cache_dir, **drill)
+        warm = _autoscale_drill(model, cache_dir, **drill)
+    if warm["aot_misses"] != 0:
+        raise RuntimeError(
+            f"warm spin-up compiled: {warm['aot_misses']} AOT cache "
+            "misses (expected 0 — every executable should load)")
+    return {
+        "metric": "autoscale_time_to_capacity",
+        "value": round(warm["time_to_capacity_s"], 3),
+        "unit": f"seconds to {target_replicas} replicas (warm AOT "
+                "cache)",
+        "cold_time_to_capacity_s": round(cold["time_to_capacity_s"], 3),
+        "warm_time_to_capacity_s": round(warm["time_to_capacity_s"], 3),
+        "cold_first_spinup_s": round(cold["first_spinup_s"], 3),
+        "warm_first_spinup_s": round(warm["first_spinup_s"], 3),
+        "cold_aot_misses": cold["aot_misses"],
+        "warm_aot_misses": warm["aot_misses"],
+        "warm_aot_hits": warm["aot_hits"],
+        "warm_zero_misses": warm["aot_misses"] == 0,
+        "scale_downs_warm": warm["scale_downs"],
+        "n_requests": n_requests,
+        "conserved": (cold["n_results"] == n_requests
+                      and warm["n_results"] == n_requests),
+        "geometry": (f"d64 L2 1->{target_replicas} replicas, "
+                     f"{n_requests} reqs, 2 slots x 64 pages x 4"),
     }
 
 
@@ -1588,7 +1727,8 @@ GATE_DEFAULT_MIN_RATIO = 0.8
 # (throughput-style rows) gates higher-is-better. Baseline entries can
 # override with an explicit "direction".
 _GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
-                         "collective_wire_bytes_per_step"}
+                         "collective_wire_bytes_per_step",
+                         "autoscale_time_to_capacity"}
 
 GATE_EXIT_CODE = 4
 
@@ -1747,7 +1887,8 @@ def main(argv=None):
                              "serving_decode_hbm_bytes,"
                              "train_peak_hbm_bytes,multichip_scaling,"
                              "pipeline_bubble_fraction,"
-                             "elastic_resume_secs")
+                             "elastic_resume_secs,"
+                             "autoscale_time_to_capacity")
     parser.add_argument("--gate", default=None, metavar="BASELINE_JSON",
                         help="compare this run's rows against a "
                              "recorded baseline (per-row thresholds); "
@@ -1925,7 +2066,8 @@ def _run(args):
                 "collective_wire_bytes_per_step",
                 "compile_cold_start", "serving_decode_hbm_bytes",
                 "train_peak_hbm_bytes", "multichip_scaling",
-                "pipeline_bubble_fraction", "elastic_resume_secs"]
+                "pipeline_bubble_fraction", "elastic_resume_secs",
+                "autoscale_time_to_capacity"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -1934,7 +2076,7 @@ def _run(args):
              "collective_wire_bytes_per_step", "compile_cold_start",
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
              "multichip_scaling", "pipeline_bubble_fraction",
-             "elastic_resume_secs"}
+             "elastic_resume_secs", "autoscale_time_to_capacity"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -1988,6 +2130,7 @@ def _run(args):
         "multichip_scaling": bench_multichip_scaling,
         "pipeline_bubble_fraction": bench_pipeline_bubble,
         "elastic_resume_secs": bench_elastic_resume_secs,
+        "autoscale_time_to_capacity": bench_autoscale_time_to_capacity,
     }
     rows_out: list[dict] = []
     headline_failed = False

@@ -28,8 +28,8 @@ from bigdl_tpu.elastic.checkpoint_writer import (CheckpointWriter,
 from bigdl_tpu.elastic.manifest import (MANIFEST_FORMAT, MANIFEST_VERSION,
                                         build_manifest, latest_checkpoint,
                                         manifest_name, mesh_layout,
-                                        read_manifest, validate_tree,
-                                        write_manifest)
+                                        read_manifest, sweep_checkpoints,
+                                        validate_tree, write_manifest)
 from bigdl_tpu.elastic.redistribute import describe_layout, redistribute
 from bigdl_tpu.elastic.runner import (ElasticRunner, ProcessChild,
                                       probe_liveness)
@@ -39,7 +39,7 @@ __all__ = ["CheckpointWriter", "ElasticRunner", "MANIFEST_FORMAT",
            "describe_layout", "latest_checkpoint", "load_checkpoint",
            "manifest_name", "mesh_layout", "probe_liveness",
            "read_manifest", "redistribute", "snapshot_to_host",
-           "validate_tree", "write_manifest"]
+           "sweep_checkpoints", "validate_tree", "write_manifest"]
 
 
 def _member_path(dir_path: str, name: str) -> str:

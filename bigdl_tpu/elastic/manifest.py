@@ -37,7 +37,8 @@ logger = logging.getLogger("bigdl_tpu.elastic")
 
 __all__ = ["MANIFEST_FORMAT", "MANIFEST_VERSION", "build_manifest",
            "latest_checkpoint", "manifest_name", "mesh_layout",
-           "read_manifest", "validate_tree", "write_manifest"]
+           "read_manifest", "sweep_checkpoints", "validate_tree",
+           "write_manifest"]
 
 MANIFEST_FORMAT = "bigdl_tpu.elastic.manifest"
 MANIFEST_VERSION = 1
@@ -173,6 +174,100 @@ def latest_checkpoint(path: str) -> dict | None:
         if best is None or int(man["neval"]) > int(best["neval"]):
             best = man
     return best
+
+
+_MEMBER_RE = re.compile(r"^(model|state)(\.\d+)?$")
+_SWEEP_RE = re.compile(
+    r"^(?:(?:model|state)(\.\d+)?|manifest(\.\d+)?\.json)(?:\.tmp)?$")
+
+
+def _list_names(path: str) -> list[str]:
+    from bigdl_tpu.utils.file import _fs_for, _is_url
+    if _is_url(path):
+        fs = _fs_for(path)
+        try:
+            return sorted(str(n).rsplit("/", 1)[-1]
+                          for n in fs.ls(path, detail=False))
+        except FileNotFoundError:
+            return []
+    try:
+        return sorted(os.listdir(path))
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
+def _remove(path: str) -> None:
+    from bigdl_tpu.utils.file import _fs_for, _is_url
+    if _is_url(path):
+        _fs_for(path).rm(path)
+    else:
+        os.remove(path)
+
+
+def sweep_checkpoints(path: str, keep: int) -> dict:
+    """Retention GC for a NUMBERED-suffix checkpoint directory
+    (``set_checkpoint(..., keep=K)``; ROADMAP 1(c)): keep the newest
+    ``keep`` complete checkpoints by ``neval``, delete the older
+    manifest+model+state triples, and sweep debris a crash can leave
+    behind — member files whose manifest never committed (the write
+    order makes them unreachable), manifests that no longer parse, and
+    leftover ``.tmp`` staging files.
+
+    Only files this format names (``model.N`` / ``state.N`` /
+    ``manifest.N.json`` and their ``.tmp`` stages) are ever touched;
+    unsuffixed overwrite-mode files and anything else in the directory
+    are left alone. Single-writer contract: call from the checkpoint
+    writer (the optimizer runs it on the async writer thread right
+    after the manifest commit), never concurrently with a write.
+    Returns ``{"kept": [neval...], "removed": [names...]}``."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    names = _list_names(path)
+
+    def full(name: str) -> str:
+        return (f"{path}/{name}" if "://" in str(path)
+                else os.path.join(path, name))
+
+    complete: dict[str, int] = {}       # numbered suffix -> neval
+    torn_manifests: list[str] = []
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if not m or m.group(1) is None:   # unsuffixed: overwrite mode
+            continue
+        try:
+            complete[m.group(1)] = int(read_manifest(full(name))["neval"])
+        except Exception as e:
+            logger.warning("checkpoint GC: sweeping unreadable manifest "
+                           "%s: %s", name, e)
+            torn_manifests.append(name)
+    keep_suffixes = {s for s, _ in sorted(complete.items(),
+                                          key=lambda kv: kv[1])[-keep:]}
+    removed = []
+    for name in names:
+        m = _SWEEP_RE.match(name)
+        if not m:
+            continue                       # not ours
+        if name.endswith(".tmp"):
+            doomed = True                  # abandoned staging file
+        elif name in torn_manifests:
+            doomed = True
+        else:
+            suffix = m.group(1) or m.group(2)
+            if suffix is None:
+                continue                   # unsuffixed: never touched
+            doomed = suffix not in keep_suffixes
+        if doomed:
+            try:
+                _remove(full(name))
+                removed.append(name)
+            except Exception as e:         # never fail the writer
+                logger.warning("checkpoint GC: could not remove %s: %s",
+                               name, e)
+    kept = sorted(complete[s] for s in keep_suffixes)
+    if removed:
+        logger.info("checkpoint GC: kept neval %s, removed %d files",
+                    kept, len(removed))
+    return {"kept": kept, "removed": removed}
 
 
 def validate_tree(tree, specs: dict | None, what: str) -> None:

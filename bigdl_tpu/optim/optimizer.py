@@ -135,6 +135,7 @@ class Optimizer:
         # loops barrier at epoch end and drain it at exit. receipt =
         # handoff_s vs write_s split after the run.
         self.checkpoint_async = True
+        self.checkpoint_keep = None
         self._ckpt_writer = None
         self._ckpt_mesh = None
         self.checkpoint_receipt = None
@@ -215,7 +216,8 @@ class Optimizer:
         self.validation_methods = list(methods)
         return self
 
-    def set_checkpoint(self, path, trigger, *, async_save: bool = True):
+    def set_checkpoint(self, path, trigger, *, async_save: bool = True,
+                       keep: int | None = None):
         """Checkpoint the full training state to ``path`` on ``trigger``
         (reference Optimizer.setCheckpoint). The directory is validated
         EAGERLY — created if absent, write-probed — so a bad path fails
@@ -224,12 +226,21 @@ class Optimizer:
         ``async_save=True`` (default) serializes checkpoints on a
         background writer thread (bigdl_tpu/elastic/, saved bytes
         bit-identical to the synchronous path); ``False`` restores the
-        fully synchronous save."""
+        fully synchronous save. ``keep=K`` enables retention GC
+        (``elastic.manifest.sweep_checkpoints``): after each manifest
+        commit only the newest K numbered checkpoints survive, and
+        torn/orphaned member files from never-committed manifests are
+        swept — long runs stop filling the store (ROADMAP 1(c)).
+        Ignored under ``overwrite_checkpoint`` (one unsuffixed
+        snapshot, nothing to retain)."""
         from bigdl_tpu.utils.file import ensure_writable_dir
         ensure_writable_dir(path)
+        if keep is not None and keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_async = bool(async_save)
+        self.checkpoint_keep = keep
         return self
 
     def overwrite_checkpoint(self):
@@ -881,11 +892,23 @@ class Optimizer:
         state_path = f"{path}/state{suffix}"
         manifest_path = f"{path}/{manifest_name(suffix)}"
 
+        keep = None if self.is_overwrite else self.checkpoint_keep
+
         def write_job():
             _file.save_module(module, model_path, overwrite=True,
                               prepared=True)
             _file.save(full_state, state_path, overwrite=True)
             write_manifest(manifest, manifest_path)  # commit point
+            if keep is not None:
+                # retention GC strictly after the commit, on the single
+                # writer thread — never concurrent with a write, and a
+                # sweep failure must not fail the checkpoint
+                from bigdl_tpu.elastic.manifest import sweep_checkpoints
+                try:
+                    sweep_checkpoints(path, keep)
+                except Exception:
+                    logger.warning("checkpoint GC failed for %s", path,
+                                   exc_info=True)
 
         handoff_s = time.perf_counter() - t0
         if self.checkpoint_async:

@@ -3,7 +3,7 @@
 The multi-replica front end over the continuous-batching stack
 (``models/transformer/serving.py``): ROADMAP item 1, the gap between a
 single ``ContinuousBatcher`` and a service (BigDL 2.0's end-to-end
-pipeline-to-serving story, arXiv:2204.01715). Four modules:
+pipeline-to-serving story, arXiv:2204.01715). Six modules:
 
 - ``slo``           — :class:`SLOConfig` targets, :class:`ReplicaStats`,
   the admission predicate and histogram-percentile helpers.
@@ -16,6 +16,12 @@ pipeline-to-serving story, arXiv:2204.01715). Four modules:
   reuse, prefill/decode disaggregation, bounded overflow +
   :class:`RouterSaturated` load-shedding, and ``drain()`` for rolling
   restarts.
+- ``autoscaler``    — :class:`Autoscaler`, the closed loop that adds
+  (AOT-warm) and drains replicas from the live SLO signals; the pure
+  :func:`decide` core is deterministic and test-table-driven.
+- ``quantized``     — int8 serving: weights + KV page pool through the
+  ``parameters/compression.py`` device codecs, shrinking per-replica
+  HBM so one chip holds more replicas.
 
 Quick start::
 
@@ -32,6 +38,8 @@ level (jaxlint JX5) — the router is host orchestration; all device
 work happens inside the batchers it drives. docs/SERVING.md covers
 architecture, SLO knobs, and the drain/rolling-restart runbook.
 """
+from bigdl_tpu.serving.autoscaler import (Autoscaler, AutoscalerConfig,
+                                          Decision, FleetView, decide)
 from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
 from bigdl_tpu.serving.replica_pool import (ACTIVE, DRAINING, STOPPED,
                                             Replica, ReplicaPool)
@@ -43,4 +51,6 @@ from bigdl_tpu.serving.slo import (ReplicaStats, SLOConfig, admissible,
 __all__ = ["SLOConfig", "ReplicaStats", "admissible", "load_score",
            "percentile", "merge_snapshots", "PrefixCache",
            "PrefixEntry", "Replica", "ReplicaPool", "ACTIVE",
-           "DRAINING", "STOPPED", "Router", "RouterSaturated"]
+           "DRAINING", "STOPPED", "Router", "RouterSaturated",
+           "Autoscaler", "AutoscalerConfig", "Decision", "FleetView",
+           "decide"]

@@ -1,0 +1,443 @@
+"""Closed-loop fleet autoscaler: capacity that follows load.
+
+The router (PR 6) made the serving plane SLO-aware but fixed-N; this
+module closes the loop (ROADMAP item 3, BigDL 2.0's
+laptop-to-cluster elasticity story, arXiv:2204.01715). An
+:class:`Autoscaler` watches the signals the replicas already export —
+TTFT / per-token decode p99 (via :func:`slo.merge_snapshots` over the
+per-replica histograms), router pending-queue depth, and KV-page
+utilization — and
+
+- **scales up** (``pool.add_replica`` + ``router.attach_replica``)
+  when a fleet percentile breaches the :class:`SLOConfig` target, the
+  pending queue outgrows the fleet, or KV pages run out. Spin-up is
+  cheap because the pool's shared AOT pipeline
+  (``ReplicaPool(aot_cache=...)``) means the Nth replica of identical
+  geometry compiles nothing — executables load from the in-process
+  table or the persistent cache;
+- **scales down** through the existing ``router.drain(name,
+  migrate=True)`` path — queued work re-dispatches and in-flight
+  sequences migrate bitwise, so conservation (every accepted request
+  completes exactly once) holds across scale events by construction —
+  but only after a *sustained* low-load window (``hysteresis_evals``
+  consecutive quiet evaluations), never below ``min_replicas``;
+- **holds** during cooldown windows after any scale event, so one
+  spike produces one measured response instead of oscillation.
+
+Latency percentiles are evaluated over WINDOWED deltas: cumulative
+histograms never decrease, so a fleet that was slow once would
+otherwise breach its p99 forever and scale up without bound. Each
+evaluation subtracts the per-replica snapshot taken at the previous
+evaluation, giving "p99 over the last window" — breaches clear when
+the fleet recovers.
+
+The decision core is the pure function :func:`decide` over a frozen
+:class:`FleetView` — deterministic, no I/O, no clocks — which is what
+the tier-1 table tests drive with synthetic histograms (no drivers, no
+sleeps). :class:`Autoscaler` is the shell: scrape, decide, apply,
+observe (``autoscaler_*`` gauges/counters, ``autoscale`` trace
+instants, flight-recorder decision events, bounded decision log).
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — decisions are
+pure arithmetic over scraped host state; the heavy lifting rides the
+pool/router primitives.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+
+from bigdl_tpu.observability import trace
+from bigdl_tpu.observability.registry import default_registry
+from bigdl_tpu.serving.slo import (SLOConfig, load_score,
+                                   merge_snapshots, percentile)
+
+__all__ = ["AutoscalerConfig", "FleetView", "Decision", "decide",
+           "Autoscaler"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Scaling policy knobs (SLO *targets* live in :class:`SLOConfig`;
+    this is how aggressively the fleet chases them).
+
+    - ``min_replicas`` / ``max_replicas``: hard fleet-size bounds; the
+      autoscaler never acts outside them.
+    - ``scale_step``: replicas added per scale-up decision.
+    - ``pending_per_replica``: router pending-queue depth tolerated per
+      live replica before the backlog itself is a breach (the queue is
+      demand the fleet failed to absorb — it breaches before p99
+      does).
+    - ``low_load_utilization``: slot-occupancy fraction at or below
+      which an evaluation counts as "quiet".
+    - ``hysteresis_evals``: consecutive quiet evaluations required
+      before a scale-down — one idle tick between bursts must not cost
+      a replica.
+    - ``cooldown_evals``: evaluations to hold after any scale event,
+      letting the new fleet shape show up in the windowed percentiles
+      before the next decision.
+    - ``interval_s``: background-loop period (``Autoscaler.start``).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_step: int = 1
+    pending_per_replica: int = 4
+    low_load_utilization: float = 0.25
+    hysteresis_evals: int = 3
+    cooldown_evals: int = 2
+    interval_s: float = 0.25
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) < min_replicas "
+                f"({self.min_replicas})")
+        if self.scale_step < 1:
+            raise ValueError("scale_step must be >= 1")
+        if self.pending_per_replica < 1:
+            raise ValueError("pending_per_replica must be >= 1")
+        if not 0.0 <= self.low_load_utilization <= 1.0:
+            raise ValueError("low_load_utilization must be in [0, 1]")
+        if self.hysteresis_evals < 1 or self.cooldown_evals < 0:
+            raise ValueError("hysteresis_evals >= 1, cooldown_evals "
+                             ">= 0 required")
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetView:
+    """One evaluation's frozen inputs: per-replica
+    :class:`~bigdl_tpu.serving.slo.ReplicaStats`, the fleet-merged
+    TTFT / decode-token histogram snapshots for the window (already
+    windowed deltas when the :class:`Autoscaler` built them), and the
+    router's pending-queue depth."""
+
+    replicas: tuple
+    ttft: dict
+    decode: dict
+    pending: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One evaluation's verdict. ``action`` is ``"up"``, ``"down"`` or
+    ``"hold"``; ``target`` is the fleet size the action aims for (==
+    ``n_live`` on hold); ``low_streak``/``cooldown`` are the NEXT
+    evaluation's carried state; ``signals`` records what the decision
+    saw (the decision log / flight recorder payload)."""
+
+    action: str
+    reason: str
+    n_live: int
+    target: int
+    low_streak: int
+    cooldown: int
+    signals: dict
+
+
+def decide(view: FleetView, *, config: AutoscalerConfig,
+           slo: SLOConfig, low_streak: int = 0,
+           cooldown: int = 0) -> Decision:
+    """Pure decision core: fleet view + carried state -> verdict.
+
+    Scale-up triggers (any one suffices): windowed TTFT or decode p99
+    over the SLO target (``inf`` — observations past every bucket —
+    breaches too), pending depth past ``pending_per_replica`` x fleet,
+    or any replica's KV pool past ``slo.max_kv_utilization``. A breach
+    resets the low-load streak; the action is still ``hold`` while a
+    cooldown is pending or the fleet is at ``max_replicas``.
+
+    Scale-down requires ``hysteresis_evals`` CONSECUTIVE quiet
+    evaluations (nothing pending, nothing queued, slot occupancy at or
+    under ``low_load_utilization``), no pending cooldown, and a fleet
+    above ``min_replicas`` — then retires exactly one replica.
+    """
+    live = [s for s in view.replicas if s.state == "active"]
+    n = len(live)
+    ttft_p99 = percentile(view.ttft, 0.99) if view.ttft else None
+    dec_p99 = percentile(view.decode, 0.99) if view.decode else None
+    kv_max = max((s.kv_utilization for s in live), default=0.0)
+    queued = sum(s.queue_depth for s in live)
+    slots = sum(s.active_slots + s.free_slots for s in live)
+    busy = (sum(s.active_slots for s in live) / slots) if slots else 0.0
+    signals = {
+        "ttft_p99_s": ttft_p99, "decode_token_p99_s": dec_p99,
+        "pending": int(view.pending), "queued": queued,
+        "kv_utilization_max": kv_max, "busy_fraction": busy,
+    }
+
+    breaches = []
+    if ttft_p99 is not None and ttft_p99 > slo.ttft_p99_s:
+        breaches.append(
+            f"ttft p99 {_fmt_s(ttft_p99)} > {slo.ttft_p99_s:.3g}s")
+    if dec_p99 is not None and dec_p99 > slo.decode_token_p99_s:
+        breaches.append(f"decode p99 {_fmt_s(dec_p99)} > "
+                        f"{slo.decode_token_p99_s:.3g}s/token")
+    if view.pending > config.pending_per_replica * max(n, 1):
+        breaches.append(
+            f"{view.pending} pending > "
+            f"{config.pending_per_replica}/replica x {max(n, 1)}")
+    if kv_max >= slo.max_kv_utilization:
+        breaches.append(f"KV pool at {kv_max:.0%} >= "
+                        f"{slo.max_kv_utilization:.0%}")
+
+    if breaches:
+        reason = "; ".join(breaches)
+        if cooldown > 0:
+            return Decision("hold", f"cooling down ({cooldown} evals "
+                            f"left): {reason}", n, n, 0,
+                            cooldown - 1, signals)
+        if n >= config.max_replicas:
+            return Decision("hold", f"at max_replicas "
+                            f"({config.max_replicas}): {reason}",
+                            n, n, 0, 0, signals)
+        target = min(n + config.scale_step, config.max_replicas)
+        return Decision("up", reason, n, target, 0,
+                        config.cooldown_evals, signals)
+
+    low = (view.pending == 0 and queued == 0
+           and busy <= config.low_load_utilization)
+    if not low:
+        return Decision("hold", "within SLO under load", n, n, 0,
+                        max(cooldown - 1, 0), signals)
+    streak = low_streak + 1
+    if cooldown > 0:
+        return Decision("hold", f"quiet but cooling down ({cooldown} "
+                        "evals left)", n, n, streak, cooldown - 1,
+                        signals)
+    if n <= config.min_replicas:
+        return Decision("hold", f"quiet at min_replicas "
+                        f"({config.min_replicas})", n, n, streak, 0,
+                        signals)
+    if streak < config.hysteresis_evals:
+        return Decision("hold", f"quiet {streak}/"
+                        f"{config.hysteresis_evals} evals", n, n,
+                        streak, 0, signals)
+    return Decision("down", f"quiet for {streak} evals", n, n - 1,
+                    0, config.cooldown_evals, signals)
+
+
+def _fmt_s(v: float) -> str:
+    return "inf" if math.isinf(v) else f"{v:.3g}s"
+
+
+_LATENCY_METRICS = ("serving_ttft_seconds",
+                    "serving_decode_token_seconds")
+
+
+def _delta_snapshot(cur: dict, prev: dict | None) -> dict:
+    """Windowed histogram: cumulative snapshot minus the previous
+    evaluation's (same metric, same replica, so boundaries match;
+    missing previous keys count from zero). Clamped at zero so a
+    replica restart (counts reset) degrades to "whole new history" not
+    negative mass."""
+    if not prev:
+        return cur
+    pb = prev.get("buckets") or {}
+    buckets = {le: max(int(c) - int(pb.get(le, 0)), 0)
+               for le, c in (cur.get("buckets") or {}).items()}
+    return {
+        "buckets": buckets,
+        "sum": max(float(cur.get("sum", 0.0))
+                   - float(prev.get("sum", 0.0)), 0.0),
+        "count": max(int(cur.get("count", 0))
+                     - int(prev.get("count", 0)), 0),
+    }
+
+
+class Autoscaler:
+    """The closed loop over a :class:`Router` (and its pool): scrape ->
+    :func:`decide` -> apply -> observe. ``evaluate()`` runs one
+    iteration synchronously (what tests and drills call);
+    ``start()``/``close()`` run it on a daemon thread every
+    ``config.interval_s``.
+
+    - ``recorder``: an optional
+      :class:`~bigdl_tpu.observability.flight_recorder.FlightRecorder`;
+      every decision lands in its event ring (postmortems answer "why
+      did the fleet resize?").
+    - ``max_decisions``: bound on the in-memory decision log
+      (``.decisions``).
+    """
+
+    def __init__(self, router, *, config: AutoscalerConfig | None = None,
+                 slo: SLOConfig | None = None, registry=None,
+                 recorder=None, max_decisions: int = 256):
+        self.router = router
+        self.pool = router.pool
+        self.config = config if config is not None else AutoscalerConfig()
+        self.slo = slo if slo is not None else router.slo
+        self._recorder = recorder
+        self.decisions: deque = deque(maxlen=int(max_decisions))
+        self._low_streak = 0
+        self._cooldown = 0
+        self._prev: dict = {}     # replica -> metric -> last snapshot
+        self._eval_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+        reg = default_registry() if registry is None else registry
+        self._g_replicas = reg.gauge(
+            "autoscaler_replicas", "live replicas at last evaluation")
+        self._g_target = reg.gauge(
+            "autoscaler_target_replicas",
+            "fleet size the last decision aimed for")
+        self._g_streak = reg.gauge(
+            "autoscaler_low_load_streak",
+            "consecutive quiet evaluations toward the scale-down "
+            "hysteresis window")
+        self._g_cooldown = reg.gauge(
+            "autoscaler_cooldown_evals",
+            "evaluations left in the post-scale-event cooldown")
+        self._m_decisions = reg.counter(
+            "autoscaler_decisions_total",
+            "autoscaler evaluations by decided action",
+            labelnames=("action",))
+        self._m_up = reg.counter(
+            "autoscaler_scale_up_total", "replicas added by scale-up")
+        self._m_down = reg.counter(
+            "autoscaler_scale_down_total",
+            "replicas drained+removed by scale-down")
+
+    # -- scrape --
+    def observe(self) -> FleetView:
+        """One fleet scrape: per-replica stats plus WINDOWED latency
+        snapshots (cumulative minus the previous evaluation's — see
+        module docstring). A replica stopped mid-scrape is skipped, not
+        fatal."""
+        stats, ttft, dec = [], [], []
+        prev_next: dict = {}
+        for rep in self.pool:
+            try:
+                stats.append(rep.stats())
+                cur = {m: rep.histogram_snapshot(m)
+                       for m in _LATENCY_METRICS}
+            except Exception:
+                continue        # drained/stopped mid-scrape
+            last = self._prev.get(rep.name)
+            ttft.append(_delta_snapshot(
+                cur[_LATENCY_METRICS[0]],
+                last and last.get(_LATENCY_METRICS[0])))
+            dec.append(_delta_snapshot(
+                cur[_LATENCY_METRICS[1]],
+                last and last.get(_LATENCY_METRICS[1])))
+            prev_next[rep.name] = cur
+        self._prev = prev_next    # removed replicas fall out here
+        return FleetView(replicas=tuple(stats),
+                         ttft=merge_snapshots(ttft),
+                         decode=merge_snapshots(dec),
+                         pending=self.router.pending_count)
+
+    # -- the loop body --
+    def evaluate(self) -> Decision:
+        """Scrape, decide, apply, record. Thread-safe; one evaluation
+        at a time."""
+        with self._eval_lock:
+            view = self.observe()
+            d = decide(view, config=self.config, slo=self.slo,
+                       low_streak=self._low_streak,
+                       cooldown=self._cooldown)
+            self._low_streak, self._cooldown = d.low_streak, d.cooldown
+            applied = {}
+            if d.action == "up":
+                applied["added"] = self._scale_up(d)
+            elif d.action == "down":
+                applied["removed"] = self._scale_down(d)
+            self._observe_decision(d, applied)
+            return d
+
+    def _scale_up(self, d: Decision) -> list:
+        added = []
+        for _ in range(d.target - d.n_live):
+            rep = self.pool.add_replica()
+            self.router.attach_replica(rep.name)
+            added.append(rep.name)
+            self._m_up.inc()
+        trace.instant("autoscale up", cat="serving", reason=d.reason,
+                      added=added, n_live=d.n_live, target=d.target)
+        return added
+
+    def _pick_victim(self) -> str | None:
+        """Lowest-load active replica, sparing the designated prefill
+        replica while any alternative exists (retiring the
+        disaggregation target forces per-request fallbacks)."""
+        live = [s for s in self.pool.stats() if s.state == "active"]
+        if len(live) <= self.config.min_replicas:
+            return None
+        spared = getattr(self.router, "_prefill_name", None)
+        cands = [s for s in live if s.name != spared] or live
+        return min(cands, key=load_score).name
+
+    def _scale_down(self, d: Decision) -> str | None:
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        # drain migrates queued + in-flight work to the survivors
+        # BEFORE the stop, so nothing is dropped or duplicated
+        self.router.drain(victim, migrate=True)
+        self.pool.remove_replica(victim)
+        self._m_down.inc()
+        trace.instant("autoscale down", cat="serving", reason=d.reason,
+                      removed=victim, n_live=d.n_live, target=d.target)
+        return victim
+
+    def _observe_decision(self, d: Decision, applied: dict) -> None:
+        self._g_replicas.set(len(self.pool))
+        self._g_target.set(d.target)
+        self._g_streak.set(d.low_streak)
+        self._g_cooldown.set(d.cooldown)
+        self._m_decisions.inc(action=d.action)
+        entry = {"t": time.time(), "action": d.action,
+                 "reason": d.reason, "n_live": d.n_live,
+                 "target": d.target, "low_streak": d.low_streak,
+                 "cooldown": d.cooldown, **applied}
+        entry.update({f"signal_{k}": v for k, v in d.signals.items()})
+        self.decisions.append(entry)
+        if self._recorder is not None:
+            try:
+                self._recorder.record("autoscale", d.action, **entry)
+            except Exception:
+                pass            # observability must not break scaling
+
+    # -- background loop --
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-serving-autoscaler",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        import logging
+        log = logging.getLogger(__name__)
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.evaluate()
+            except Exception:
+                # one bad evaluation (replica racing a manual drain,
+                # say) must not kill the loop
+                log.exception("autoscaler evaluation failed")
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -227,28 +227,88 @@ class ReplicaPool:
 
     def __init__(self, model, n_replicas: int = 2, *, names=None,
                  burst=None, health=None, start: bool = True,
-                 **batcher_kwargs):
+                 aot_cache=None, **batcher_kwargs):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
-        # lazy: keeps this module importable without jax (JX5 contract)
-        from bigdl_tpu.models.transformer.serving import ContinuousBatcher
         if names is None:
             names = [f"r{i}" for i in range(n_replicas)]
         if len(names) != n_replicas or len(set(names)) != n_replicas:
             raise ValueError(f"need {n_replicas} distinct names, got "
                              f"{names}")
         self._health = health if health is not None else default_health()
+        self._model = model
+        self._burst = burst
+        self._batcher_kwargs = dict(batcher_kwargs)
+        # ONE shared AOT pipeline for every replica this pool ever
+        # builds (autoscaler spin-ups included): the first replica
+        # compiles each step and stores the executable; the Nth replica
+        # of identical geometry compiles nothing. ``aot_cache`` accepts
+        # a PagedStepCompilers, an AOTCache, or a cache directory path.
+        self.aot = None
+        if aot_cache is not None:
+            # lazy: keeps this module importable without jax (JX5)
+            from bigdl_tpu.models.transformer.serving import \
+                PagedStepCompilers
+            self.aot = (aot_cache
+                        if isinstance(aot_cache, PagedStepCompilers)
+                        else PagedStepCompilers(aot_cache))
+            self._batcher_kwargs["aot_cache"] = self.aot
+        self._running = False
+        self._next_auto = n_replicas
         self.replicas: dict[str, Replica] = {}
         for name in names:
-            reg = MetricRegistry()
-            batcher = ContinuousBatcher(
-                model, registry=reg, health=self._health,
-                health_name=f"serving_batcher_{name}", **batcher_kwargs)
-            self.replicas[name] = Replica(name, batcher, registry=reg,
-                                          burst=burst,
-                                          health=self._health)
+            self._build_replica(name)
         if start:
             self.start()
+
+    def _build_replica(self, name: str) -> Replica:
+        # lazy: keeps this module importable without jax (JX5 contract)
+        from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+        reg = MetricRegistry()
+        batcher = ContinuousBatcher(
+            self._model, registry=reg, health=self._health,
+            health_name=f"serving_batcher_{name}",
+            **self._batcher_kwargs)
+        rep = Replica(name, batcher, registry=reg, burst=self._burst,
+                      health=self._health)
+        self.replicas[name] = rep
+        return rep
+
+    # -- elastic membership (the autoscaler's primitives) --
+    def add_replica(self, name: str | None = None, *, start: bool = True,
+                    warm: bool = True) -> Replica:
+        """Build one more identically configured replica and (with the
+        pool running) put it in rotation. With the pool's shared AOT
+        pipeline the new batcher compiles nothing — its executables
+        come from the in-process table or the cache directory; with
+        ``warm=True`` its default decode executable is readied before
+        the driver starts, so the first routed request never waits on
+        construction. Auto-names ``rN`` when ``name`` is omitted.
+        Registers the replica's two health checks as a side effect of
+        construction. Callers fronting the pool with a Router must also
+        ``router.attach_replica(name)`` to wire completion hooks."""
+        if name is None:
+            while f"r{self._next_auto}" in self.replicas:
+                self._next_auto += 1
+            name = f"r{self._next_auto}"
+            self._next_auto += 1
+        if name in self.replicas:
+            raise ValueError(f"replica {name!r} already exists")
+        rep = self._build_replica(name)
+        if warm:
+            rep.batcher.warmup()
+        if start and self._running:
+            rep.start()
+        return rep
+
+    def remove_replica(self, name: str, timeout: float = 10.0) -> None:
+        """Stop and drop replica ``name``: the driver thread joins and
+        BOTH its health checks unregister, so ``/readyz`` of a
+        scaled-down fleet reports only live replicas. The caller drains
+        first (``Router.drain(name, migrate=True)``) — work still
+        queued or in flight here is lost. KeyError for unknown names."""
+        rep = self.replicas.pop(name)
+        rep.stop(timeout)
 
     @property
     def names(self) -> list[str]:
@@ -258,21 +318,24 @@ class ReplicaPool:
         return self.replicas[name]
 
     def __iter__(self):
-        return iter(self.replicas.values())
+        # snapshot: scale events mutate the dict from other threads
+        # while health probes / fleet-stats scrapes iterate it
+        return iter(list(self.replicas.values()))
 
     def __len__(self) -> int:
         return len(self.replicas)
 
     def start(self) -> "ReplicaPool":
-        for r in self.replicas.values():
+        self._running = True
+        for r in list(self.replicas.values()):
             r.start()
         return self
 
     def stats(self) -> list[ReplicaStats]:
-        return [r.stats() for r in self.replicas.values()]
+        return [r.stats() for r in list(self.replicas.values())]
 
     def close(self, timeout: float = 10.0) -> None:
-        for r in self.replicas.values():
+        for r in list(self.replicas.values()):
             r.stop(timeout)
 
     def __enter__(self) -> "ReplicaPool":

@@ -427,6 +427,17 @@ class Router:
         self.pool[name].resume()
         self._pump_wake.set()
 
+    def attach_replica(self, name: str) -> None:
+        """Wire a replica added to the pool AFTER router construction
+        (``pool.add_replica``) into the result stream: completion /
+        prefix-capture hooks plus a dispatcher wake so pending work
+        spills onto the new capacity immediately. Idempotent."""
+        rep = self.pool[name]
+        rep.batcher.on_complete = self._make_on_complete(name)
+        if self._capture:
+            rep.batcher.on_prefill = self._make_on_prefill(name)
+        self._pump_wake.set()
+
     # -- fleet latency view (bench serving rows) --
     def latency_summary(self) -> dict:
         """Fleet-wide latency percentiles: per-replica histograms

@@ -89,35 +89,110 @@ class ReplicaStats:
     prefill_skips: int = 0
 
 
+def _sorted_bounds(buckets: dict) -> list[tuple[float, str]]:
+    """Numerically sorted ``(value, original_key)`` bucket boundaries.
+    Unparseable keys are dropped rather than crashing a scrape — a
+    half-written exposition from a replica mid-drain must never take
+    the autoscaler's decision loop down with it."""
+    bounds = []
+    for le in buckets:
+        try:
+            bounds.append((float(le), le))
+        except (TypeError, ValueError):
+            continue
+    bounds.sort(key=lambda bv: bv[0])
+    return bounds
+
+
 def percentile(snapshot: dict, q: float) -> float | None:
     """Quantile ``q`` in (0, 1] from a cumulative histogram snapshot
     (``{"buckets": {le: cumulative_count}, "count": n}``). Returns the
     smallest bucket upper bound covering the quantile — a conservative
-    (never-under) estimate; ``None`` with no observations."""
+    (never-under) estimate; ``None`` with no observations. Tolerates
+    ``None``/empty/partially-garbled snapshots (a replica drained
+    mid-scrape) by treating them as empty."""
     if not 0.0 < q <= 1.0:
         raise ValueError(f"q must be in (0, 1], got {q}")
-    n = int(snapshot.get("count", 0))
-    if n == 0:
+    if not snapshot:
+        return None
+    try:
+        n = int(snapshot.get("count", 0))
+    except (TypeError, ValueError):
+        return None
+    if n <= 0:
         return None
     need = math.ceil(q * n)
-    for le, cum in snapshot.get("buckets", {}).items():
-        if cum >= need:
-            return float(le)
+    buckets = snapshot.get("buckets") or {}
+    # numeric boundary order, NOT dict insertion order: merged or
+    # hand-built snapshots may interleave boundaries
+    for val, le in _sorted_bounds(buckets):
+        try:
+            if int(buckets[le]) >= need:
+                return val
+        except (TypeError, ValueError):
+            continue
     return math.inf
 
 
 def merge_snapshots(snapshots) -> dict:
-    """Sum cumulative histogram snapshots taken from IDENTICAL bucket
-    boundaries (true for any one metric name across replica
-    registries). The merge of cumulative counts is cumulative again, so
-    :func:`percentile` applies directly — fleet-wide p50/p99."""
-    out: dict = {"buckets": {}, "sum": 0.0, "count": 0}
-    for s in snapshots:
-        out["sum"] += float(s.get("sum", 0.0))
-        out["count"] += int(s.get("count", 0))
-        for le, cum in s.get("buckets", {}).items():
-            out["buckets"][le] = out["buckets"].get(le, 0) + cum
-    return out
+    """Merge cumulative histogram snapshots into one fleet-wide
+    cumulative snapshot, so :func:`percentile` applies directly.
+
+    Snapshots from one metric name across replica registries share
+    boundaries, and for those this is a plain per-bucket sum. But the
+    autoscaler merges whatever the scrape returned — a replica drained
+    mid-decision may contribute an empty dict, ``None``, or (across
+    versions) different boundaries. The merge therefore re-evaluates
+    each snapshot's cumulative count at the UNION of all boundaries:
+    the count at boundary ``x`` is the snapshot's count at its largest
+    own boundary ``<= x`` (a lower bound on the true cumulative count,
+    keeping the percentile estimate conservative — never under)."""
+    merged: dict = {"buckets": {}, "sum": 0.0, "count": 0}
+    per_snap: list[tuple[list[tuple[float, str]], dict, int]] = []
+    union: dict[float, str] = {}
+    for s in snapshots or ():
+        if not s:
+            continue
+        try:
+            merged["sum"] += float(s.get("sum", 0.0))
+        except (TypeError, ValueError):
+            pass
+        try:
+            count = int(s.get("count", 0))
+        except (TypeError, ValueError):
+            count = 0
+        merged["count"] += max(count, 0)
+        buckets = s.get("buckets") or {}
+        bounds = _sorted_bounds(buckets)
+        if not bounds and count > 0:
+            # count but no usable buckets: everything lands at +Inf so
+            # the total stays covered (percentile degrades to inf
+            # rather than silently dropping observations)
+            bounds, buckets = [(math.inf, "+Inf")], {"+Inf": count}
+        for val, le in bounds:
+            union.setdefault(val, le)
+        if bounds:
+            per_snap.append((bounds, buckets, max(count, 0)))
+    for val in sorted(union):
+        total = 0
+        for bounds, buckets, count in per_snap:
+            cum = 0
+            for bval, ble in bounds:
+                if bval > val:
+                    break
+                try:
+                    cum = max(cum, int(buckets[ble]))
+                except (TypeError, ValueError):
+                    continue
+            total += min(cum, count) if count else cum
+        merged["buckets"][union[val]] = total
+    if merged["count"] and merged["buckets"]:
+        # the top boundary must cover every merged observation
+        top = union[max(union)]
+        if math.isinf(max(union)):
+            merged["buckets"][top] = max(merged["buckets"][top],
+                                         merged["count"])
+    return merged
 
 
 def admissible(stats: ReplicaStats, slo: SLOConfig) -> tuple[bool, str]:

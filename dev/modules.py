@@ -34,7 +34,9 @@ MODULES = {
                       "tests/test_telemetry.py"],
     "tuning": ["tests/test_tuning.py"],
     "elastic": ["tests/test_elastic.py"],
-    "serving": ["tests/test_serving_router.py"],
+    "serving": ["tests/test_serving_router.py",
+                "tests/test_autoscaler.py",
+                "tests/test_quantized_serving.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],

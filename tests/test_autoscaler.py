@@ -1,0 +1,482 @@
+"""Fleet autoscaler contracts (bigdl_tpu/serving/autoscaler.py; ISSUE 15).
+
+Two layers, matching the module's split:
+
+- the pure decision core ``decide()`` driven by FROZEN fleet views —
+  synthetic ReplicaStats + hand-built histogram snapshots, no drivers,
+  no sleeps, no clocks: every scale-up trigger, the cooldown and
+  hysteresis state machine, and the min/max bounds are table-tested;
+- the closed loop against a REAL 1-replica plane (tiny model, CPU):
+  an admission spike scales the fleet up with health checks registered
+  per replica, every request completes exactly once with greedy
+  parity, and sustained quiet retires the spike capacity with health
+  checks unregistered (satellite: ``remove_replica`` -> ``stop()``
+  prunes both /readyz entries).
+
+The windowed-percentile machinery (`_delta_snapshot`) and the hardened
+``percentile``/``merge_snapshots`` edges (None/empty/garbled/
+boundary-mismatched snapshots — a replica drained mid-scrape) are
+pinned here too.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                   generate)
+from bigdl_tpu.observability.exporter import HealthRegistry
+from bigdl_tpu.observability.flight_recorder import FlightRecorder
+from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.serving import (Autoscaler, AutoscalerConfig, Decision,
+                               FleetView, ReplicaPool, Router, SLOConfig,
+                               decide)
+from bigdl_tpu.serving.autoscaler import _delta_snapshot
+from bigdl_tpu.serving.slo import (ReplicaStats, merge_snapshots,
+                                   percentile)
+
+V = 32
+
+CFG = AutoscalerConfig(min_replicas=1, max_replicas=4,
+                       pending_per_replica=4, low_load_utilization=0.25,
+                       hysteresis_evals=3, cooldown_evals=2)
+SLO = SLOConfig()           # ttft 2s, decode 1s/token, kv 0.95
+
+
+def _stats(name="r0", state="active", queue=0, active=0, free=2,
+           pages_free=60, kv=0.0):
+    return ReplicaStats(name=name, state=state, queue_depth=queue,
+                        active_slots=active, free_slots=free,
+                        pages_free=pages_free, kv_utilization=kv)
+
+
+def _snap(pairs, count=None, total=None):
+    """Cumulative histogram snapshot from (le, cumulative_count)
+    pairs."""
+    buckets = dict(pairs)
+    n = count if count is not None else max(
+        (int(c) for c in buckets.values()), default=0)
+    return {"buckets": buckets, "count": n,
+            "sum": float(total if total is not None else n)}
+
+
+FAST = _snap([("0.1", 10), ("+Inf", 10)])        # p99 = 0.1s
+SLOW = _snap([("1.0", 0), ("5.0", 10), ("+Inf", 10)])   # p99 = 5.0s
+EMPTY = {"buckets": {}, "count": 0, "sum": 0.0}
+
+
+def _view(replicas=None, ttft=None, decode=None, pending=0):
+    return FleetView(replicas=tuple(replicas or (_stats(),)),
+                     ttft=ttft if ttft is not None else EMPTY,
+                     decode=decode if decode is not None else EMPTY,
+                     pending=pending)
+
+
+class TestDecideScaleUp:
+    def test_ttft_p99_breach_scales_up(self):
+        d = decide(_view(ttft=SLOW), config=CFG, slo=SLO)
+        assert isinstance(d, Decision)
+        assert d.action == "up"
+        assert d.target == 2 and d.n_live == 1
+        assert "ttft p99" in d.reason
+        assert d.cooldown == CFG.cooldown_evals
+        assert d.signals["ttft_p99_s"] == 5.0
+
+    def test_decode_p99_breach_scales_up(self):
+        d = decide(_view(decode=SLOW), config=CFG, slo=SLO)
+        assert d.action == "up"
+        assert "decode p99" in d.reason
+
+    def test_inf_percentile_breaches(self):
+        """Observations past every finite bucket estimate to +Inf —
+        that MUST read as a breach, not a skipped comparison."""
+        torn = _snap([("0.5", 0)], count=10)    # 10 obs, none covered
+        d = decide(_view(ttft=torn), config=CFG, slo=SLO)
+        assert d.action == "up"
+        assert math.isinf(d.signals["ttft_p99_s"])
+
+    def test_pending_queue_growth_scales_up(self):
+        d = decide(_view(pending=5), config=CFG, slo=SLO)
+        assert d.action == "up"
+        assert "pending" in d.reason
+        # at the threshold is NOT a breach (strictly greater triggers)
+        d = decide(_view(pending=4), config=CFG, slo=SLO)
+        assert d.action == "hold"
+
+    def test_pending_threshold_scales_with_fleet(self):
+        reps = [_stats(f"r{i}", active=2, free=0) for i in range(2)]
+        d = decide(_view(reps, pending=8), config=CFG, slo=SLO)
+        assert d.action == "hold"        # 8 <= 4/replica x 2
+        d = decide(_view(reps, pending=9), config=CFG, slo=SLO)
+        assert d.action == "up" and d.target == 3
+
+    def test_kv_pressure_scales_up(self):
+        reps = [_stats("r0", kv=0.2), _stats("r1", kv=0.96)]
+        d = decide(_view(reps), config=CFG, slo=SLO)
+        assert d.action == "up"
+        assert "KV pool" in d.reason
+        assert d.signals["kv_utilization_max"] == 0.96
+
+    def test_scale_step_and_max_clamp(self):
+        cfg = AutoscalerConfig(max_replicas=4, scale_step=3)
+        reps = [_stats(f"r{i}") for i in range(2)]
+        d = decide(_view(reps, ttft=SLOW), config=cfg, slo=SLO)
+        assert d.action == "up" and d.target == 4    # 2+3 clamped to 4
+
+    def test_breach_at_max_holds(self):
+        reps = [_stats(f"r{i}", active=2, free=0) for i in range(4)]
+        d = decide(_view(reps, ttft=SLOW), config=CFG, slo=SLO)
+        assert d.action == "hold"
+        assert "at max_replicas" in d.reason
+        assert d.target == 4
+
+    def test_breach_during_cooldown_holds_and_decrements(self):
+        d = decide(_view(ttft=SLOW), config=CFG, slo=SLO, cooldown=2)
+        assert d.action == "hold"
+        assert "cooling down" in d.reason
+        assert d.cooldown == 1
+        assert d.low_streak == 0          # a breach resets the streak
+
+    def test_only_active_replicas_count(self):
+        """A draining replica is not capacity: the pending threshold
+        and busy fraction see the ACTIVE fleet only."""
+        reps = [_stats("r0"), _stats("r1", state="draining", active=2)]
+        d = decide(_view(reps, pending=5), config=CFG, slo=SLO)
+        assert d.action == "up"
+        assert d.n_live == 1 and d.target == 2
+
+
+class TestDecideScaleDown:
+    QUIET = [_stats("r0", active=0, free=2), _stats("r1", active=0,
+                                                    free=2)]
+
+    def test_hysteresis_counts_quiet_evals(self):
+        streak = 0
+        for expect in (1, 2):
+            d = decide(_view(self.QUIET), config=CFG, slo=SLO,
+                       low_streak=streak)
+            assert d.action == "hold"
+            assert f"quiet {expect}/3" in d.reason
+            streak = d.low_streak
+        d = decide(_view(self.QUIET), config=CFG, slo=SLO,
+                   low_streak=streak)
+        assert d.action == "down"
+        assert d.target == 1 and d.n_live == 2
+        assert d.low_streak == 0
+        assert d.cooldown == CFG.cooldown_evals
+
+    def test_load_resets_streak(self):
+        busy = [_stats("r0", active=2, free=0), _stats("r1")]
+        d = decide(_view(busy), config=CFG, slo=SLO, low_streak=2)
+        assert d.action == "hold"
+        assert d.reason == "within SLO under load"
+        assert d.low_streak == 0
+
+    def test_quiet_at_min_holds_forever(self):
+        d = decide(_view([_stats("r0")]), config=CFG, slo=SLO,
+                   low_streak=99)
+        assert d.action == "hold"
+        assert "min_replicas" in d.reason
+
+    def test_quiet_during_cooldown_keeps_counting(self):
+        """Cooldown delays the scale-down but must not discard the
+        accumulating quiet evidence."""
+        d = decide(_view(self.QUIET), config=CFG, slo=SLO,
+                   low_streak=1, cooldown=1)
+        assert d.action == "hold"
+        assert d.low_streak == 2 and d.cooldown == 0
+
+    def test_busy_fraction_gates_quiet(self):
+        half_busy = [_stats("r0", active=1, free=1),
+                     _stats("r1", active=0, free=2)]    # busy 0.25
+        d = decide(_view(half_busy), config=CFG, slo=SLO, low_streak=0)
+        assert d.action == "hold"
+        assert d.low_streak == 1          # 0.25 <= 0.25 counts as quiet
+        more = [_stats("r0", active=2, free=0), _stats("r1")]   # 0.5
+        d = decide(_view(more), config=CFG, slo=SLO, low_streak=1)
+        assert d.low_streak == 0
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize("kw", [
+        dict(min_replicas=0), dict(min_replicas=3, max_replicas=2),
+        dict(scale_step=0), dict(pending_per_replica=0),
+        dict(low_load_utilization=1.5), dict(hysteresis_evals=0),
+        dict(cooldown_evals=-1), dict(interval_s=0.0),
+    ])
+    def test_bad_knobs_raise(self, kw):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(**kw)
+
+
+class TestWindowing:
+    def test_delta_subtracts_previous_snapshot(self):
+        prev = _snap([("0.1", 5), ("+Inf", 5)], total=0.5)
+        cur = _snap([("0.1", 5), ("+Inf", 8)], count=8, total=3.5)
+        d = _delta_snapshot(cur, prev)
+        assert d["buckets"] == {"0.1": 0, "+Inf": 3}
+        assert d["count"] == 3 and d["sum"] == 3.0
+        # the windowed p99 sees only the NEW (slow) observations
+        assert math.isinf(percentile(d, 0.99))
+
+    def test_no_previous_passes_through(self):
+        assert _delta_snapshot(FAST, None) is FAST
+        assert _delta_snapshot(FAST, {}) is FAST
+
+    def test_replica_restart_clamps_at_zero(self):
+        prev = _snap([("0.1", 9), ("+Inf", 9)])
+        cur = _snap([("0.1", 2), ("+Inf", 2)])    # counters reset
+        d = _delta_snapshot(cur, prev)
+        assert d["count"] == 0
+        assert all(c == 0 for c in d["buckets"].values())
+
+    def test_breach_clears_after_quiet_window(self):
+        """The raison d'etre: a fleet that was slow ONCE must not
+        breach forever. The cumulative snapshot keeps the slow mass;
+        the windowed delta over a quiet window is empty -> no breach."""
+        slow_then_quiet = _delta_snapshot(SLOW, SLOW)
+        d = decide(_view(ttft=slow_then_quiet), config=CFG, slo=SLO)
+        assert d.action != "up"
+        assert d.signals["ttft_p99_s"] is None
+
+
+class TestSLOHardening:
+    """Satellite: percentile/merge_snapshots over the snapshots a live
+    scrape actually produces — None, empty, garbled, mismatched."""
+
+    def test_percentile_rejects_bad_q(self):
+        for q in (0.0, -0.5, 1.5):
+            with pytest.raises(ValueError):
+                percentile(FAST, q)
+
+    def test_percentile_empty_and_none(self):
+        assert percentile(None, 0.99) is None
+        assert percentile({}, 0.99) is None
+        assert percentile(EMPTY, 0.99) is None
+        assert percentile({"count": "garbage"}, 0.99) is None
+
+    def test_percentile_numeric_bucket_order(self):
+        """Insertion order must not matter — merged snapshots
+        interleave boundaries."""
+        s = _snap([("10.0", 10), ("0.5", 3), ("2.0", 7)], count=10)
+        assert percentile(s, 0.3) == 0.5
+        assert percentile(s, 0.7) == 2.0
+        assert percentile(s, 1.0) == 10.0
+
+    def test_percentile_garbled_keys_skipped(self):
+        s = {"buckets": {"not-a-number": 10, "0.1": 10, "+Inf": 10},
+             "count": 10, "sum": 1.0}
+        assert percentile(s, 0.99) == 0.1
+
+    def test_percentile_uncovered_is_inf(self):
+        assert percentile(_snap([("0.5", 2)], count=10), 0.99) == \
+            math.inf
+
+    def test_merge_empty_inputs(self):
+        for snaps in ((), None, [None, {}, EMPTY]):
+            m = merge_snapshots(snaps)
+            assert m["count"] == 0
+            assert percentile(m, 0.99) is None
+
+    def test_merge_same_boundaries_sums(self):
+        m = merge_snapshots([FAST, FAST])
+        assert m["count"] == 20
+        assert m["buckets"]["0.1"] == 20
+        assert percentile(m, 0.99) == 0.1
+
+    def test_merge_mismatched_boundaries_conservative(self):
+        """Union-of-boundaries merge: the estimate may round UP to a
+        coarser bucket but never under-reports."""
+        a = _snap([("0.1", 10), ("+Inf", 10)])
+        b = _snap([("0.25", 4), ("+Inf", 4)])
+        m = merge_snapshots([a, b])
+        assert m["count"] == 14
+        p = percentile(m, 0.99)
+        assert p is not None and p >= 0.25
+
+    def test_merge_count_without_buckets_forces_inf_coverage(self):
+        """A snapshot with observations but no usable buckets must not
+        silently vanish: the mass lands at +Inf so the fleet p99
+        degrades loudly instead of optimistically."""
+        m = merge_snapshots([FAST, {"count": 5, "sum": 2.0,
+                                    "buckets": {"junk": "x"}}])
+        assert m["count"] == 15
+        assert percentile(m, 1.0) == math.inf
+        assert percentile(m, 0.5) == 0.1
+
+    def test_merge_then_decide_end_to_end(self):
+        """The autoscaler's actual composition: two replica windows ->
+        fleet snapshot -> decision."""
+        m = merge_snapshots([FAST, SLOW])
+        d = decide(_view(ttft=m), config=CFG, slo=SLO)
+        assert d.action == "up"
+
+
+GEO = dict(max_batch=2, num_pages=64, page_size=4, max_new_tokens=6,
+           max_burst=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                      max_len=64)
+    m.materialize(jax.random.PRNGKey(6))
+    m.evaluate()
+    return m
+
+
+def _prompts(lengths, seed=4):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(1, V + 1, size=(n,))) for n in lengths]
+
+
+def _greedy(model, prompt, n_new=6):
+    cfg = GenerationConfig(max_new_tokens=n_new, temperature=0.0)
+    return np.asarray(generate(model, np.asarray([prompt], np.int32),
+                               cfg))[0]
+
+
+def _health_names(health):
+    return {c.name for c in health.checks()}
+
+
+class TestClosedLoop:
+    """The shell against a REAL plane: spike -> scale-up -> conserve ->
+    quiet -> scale-down, with observability checked at each step."""
+
+    def test_spike_scales_up_serves_all_then_retires(self, model):
+        health, reg = HealthRegistry(), MetricRegistry()
+        rec = FlightRecorder(dir=None)
+        pool = ReplicaPool(model, 1, health=health, **GEO)
+        router = Router(pool, slo=SLOConfig(long_prefill_tokens=32,
+                                            max_queue_depth=2),
+                        registry=MetricRegistry(), health=health,
+                        capture_prefixes=False)
+        cfg = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                               pending_per_replica=2,
+                               hysteresis_evals=2, cooldown_evals=0)
+        asc = Autoscaler(router, config=cfg, registry=reg, recorder=rec)
+        prompts = _prompts([5, 7, 3, 9, 4, 6, 5, 8, 3, 7, 6, 4])
+        try:
+            for i, p in enumerate(prompts):
+                router.submit(i, p)
+            # the spike breaches pending_per_replica immediately; two
+            # evaluations (cooldown 0) grow the fleet to max
+            d1 = asc.evaluate()
+            assert d1.action == "up" and "pending" in d1.reason
+            deadline = 60.0
+            import time as _time
+            t0 = _time.monotonic()
+            while len(pool) < 3 and _time.monotonic() - t0 < deadline:
+                asc.evaluate()
+                _time.sleep(0.01)
+            assert len(pool) == 3, pool.names
+            # every added replica carries BOTH health checks
+            for name in pool.names:
+                assert f"serving_replica_{name}" in _health_names(health)
+                assert f"serving_batcher_{name}" in _health_names(health)
+            assert reg.get("autoscaler_replicas").value() == 3
+            assert reg.get("autoscaler_scale_up_total").value() == 2
+
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            # conservation: exactly once each, greedy parity
+            assert sorted(res) == list(range(len(prompts)))
+            for i, p in enumerate(prompts):
+                np.testing.assert_array_equal(res[i], _greedy(model, p),
+                                              err_msg=f"req {i}")
+
+            # sustained quiet retires the spike capacity
+            downs = 0
+            for _ in range(20):
+                if asc.evaluate().action == "down":
+                    downs += 1
+                if len(pool) == 1:
+                    break
+            assert len(pool) == 1 and downs == 2
+            assert reg.get("autoscaler_scale_down_total").value() == 2
+            # satellite: remove_replica -> stop() pruned BOTH health
+            # checks for the retired replicas
+            live = pool.names[0]
+            names = _health_names(health)
+            assert {n for n in names if n.startswith("serving_")} == {
+                f"serving_replica_{live}", f"serving_batcher_{live}",
+                "serving_router"}
+            # late results (drain/migrate) still conserved
+            assert dict(router.finished()) == {}
+            assert router.inflight_count == 0
+
+            # decision log + flight recorder both saw every decision
+            assert len(asc.decisions) >= 4
+            acts = [e["action"] for e in asc.decisions]
+            assert acts.count("up") == 2 and acts.count("down") == 2
+            ev = [e for e in rec.events() if e["kind"] == "autoscale"]
+            assert [e["name"] for e in ev] == acts
+            assert all("signal_pending" in e for e in ev)
+        finally:
+            asc.close()
+            router.close()
+            pool.close()
+
+    def test_duplicate_and_bounds_guards(self, model):
+        health = HealthRegistry()
+        pool = ReplicaPool(model, 1, health=health, start=False, **GEO)
+        try:
+            with pytest.raises(ValueError):
+                pool.add_replica("r0")
+            with pytest.raises(KeyError):
+                pool.remove_replica("nope")
+            # auto-naming skips existing names
+            rep = pool.add_replica(start=False, warm=False)
+            assert rep.name == "r1"
+        finally:
+            pool.close()
+
+    @pytest.mark.slow
+    def test_spike_drill_warm_aot_zero_misses(self, model, tmp_path):
+        """Spin-up receipt, in-process: a second fleet over the same
+        AOT cache directory scales 1 -> 3 under spike with ZERO
+        compile misses — every executable deserializes."""
+        slo = SLOConfig(long_prefill_tokens=32, max_queue_depth=2)
+        cfg = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                               pending_per_replica=2,
+                               hysteresis_evals=2, cooldown_evals=0)
+        prompts = _prompts([5, 7, 3, 9, 4, 6, 5, 8, 3, 7, 6, 4])
+
+        def drill():
+            health = HealthRegistry()
+            pool = ReplicaPool(model, 1, health=health, start=False,
+                               aot_cache=str(tmp_path), **GEO)
+            pool["r0"].batcher.warmup(prompt_buckets=(16,))
+            pool.start()
+            router = Router(pool, slo=slo, health=health,
+                            registry=MetricRegistry(),
+                            capture_prefixes=False)
+            asc = Autoscaler(router, config=cfg,
+                             registry=MetricRegistry())
+            try:
+                for i, p in enumerate(prompts):
+                    router.submit(i, p)
+                import time as _time
+                t0 = _time.monotonic()
+                while len(pool) < 3 and _time.monotonic() - t0 < 120:
+                    asc.evaluate()
+                    _time.sleep(0.01)
+                assert len(pool) == 3
+                router.wait_all(timeout=120)
+                assert sorted(dict(router.finished())) == \
+                    list(range(len(prompts)))
+                return pool.aot.hits, pool.aot.misses
+            finally:
+                asc.close()
+                router.close()
+                pool.close()
+
+        cold_hits, cold_misses = drill()
+        assert cold_misses >= 1            # the cold pass compiled
+        warm_hits, warm_misses = drill()
+        assert warm_misses == 0            # the warm fleet compiled NOTHING
+        assert warm_hits >= cold_misses

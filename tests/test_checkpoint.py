@@ -314,3 +314,126 @@ class TestOverwriteCheckpointSemantics:
         assert man["neval"] == 8
         assert int(np.asarray(bfile.load(
             f"{tmp_path}/state")["neval"])) == 8
+
+
+class TestCheckpointGC:
+    """ISSUE 15 satellite (ROADMAP 1(c)): ``set_checkpoint(...,
+    keep=K)`` retains the newest K complete snapshots and sweeps
+    orphaned members + stale ``.tmp`` staging files, never touching
+    overwrite-mode or foreign files."""
+
+    def _run(self, ck, *, keep, iters=12, every=4, overwrite=False):
+        RandomGenerator.set_seed(11)
+        model = make_model()
+        ds = make_dataset() >> SampleToBatch(16, drop_remainder=True)
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_checkpoint(str(ck), optim.several_iteration(every),
+                         keep=keep)
+        if overwrite:
+            o.overwrite_checkpoint()
+        o.set_end_when(optim.max_iteration(iters))
+        o.optimize()
+
+    def test_keep_last_k_end_to_end(self, tmp_path):
+        """Three trigger fires with keep=2: only the newest two triples
+        survive, and the kept latest still resumes."""
+        self._run(tmp_path, keep=2)
+        import os
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["manifest.12.json", "manifest.8.json",
+                         "model.12", "model.8", "state.12", "state.8"]
+        from bigdl_tpu import elastic
+        model, state, man = elastic.load_checkpoint(str(tmp_path))
+        assert man["neval"] == 12
+        assert int(np.asarray(state["neval"])) == 12
+
+    def test_keep_one(self, tmp_path):
+        self._run(tmp_path, keep=1)
+        import os
+        assert sorted(os.listdir(tmp_path)) == [
+            "manifest.12.json", "model.12", "state.12"]
+
+    def test_keep_validation(self, tmp_path):
+        o = optim.Optimizer(model=make_model(),
+                            dataset=make_dataset()
+                            >> SampleToBatch(16, drop_remainder=True),
+                            criterion=nn.ClassNLLCriterion())
+        with pytest.raises(ValueError):
+            o.set_checkpoint(str(tmp_path), optim.several_iteration(4),
+                             keep=0)
+        from bigdl_tpu.elastic import sweep_checkpoints
+        with pytest.raises(ValueError):
+            sweep_checkpoints(str(tmp_path), 0)
+
+    def test_overwrite_mode_ignores_keep(self, tmp_path):
+        """Unsuffixed overwrite-mode snapshots are not GC's to manage —
+        keep composes with overwrite_checkpoint() as a no-op."""
+        self._run(tmp_path, keep=1, iters=8, overwrite=True)
+        import os
+        assert sorted(os.listdir(tmp_path)) == ["manifest.json",
+                                                "model", "state"]
+
+    def test_sweep_orphans_torn_and_tmp(self, tmp_path):
+        """The crash-debris sweep, synthetically: members without a
+        committed manifest, manifests that no longer parse, and
+        abandoned ``.tmp`` stages all go; unsuffixed and foreign files
+        stay."""
+        import os
+
+        from bigdl_tpu.elastic import sweep_checkpoints
+        from bigdl_tpu.elastic.manifest import (build_manifest,
+                                                write_manifest)
+
+        def member(name):
+            (tmp_path / name).write_bytes(b"x")
+
+        for neval in (2, 5, 9):
+            member(f"model.{neval}")
+            member(f"state.{neval}")
+            write_manifest(
+                build_manifest(neval=neval, epoch=1,
+                               model_file=f"model.{neval}",
+                               state_file=f"state.{neval}"),
+                str(tmp_path / f"manifest.{neval}.json"))
+        member("model.7")                      # orphan: manifest never
+        member("state.7")                      # committed
+        (tmp_path / "manifest.3.json").write_text("{torn")
+        member("model.3")
+        member("state.99.tmp")                 # abandoned staging file
+        member("model")                        # overwrite-mode snapshot
+        member("state")
+        (tmp_path / "notes.txt").write_text("mine")   # foreign
+
+        out = sweep_checkpoints(str(tmp_path), keep=2)
+        assert out["kept"] == [5, 9]
+        assert sorted(os.listdir(tmp_path)) == [
+            "manifest.5.json", "manifest.9.json", "model", "model.5",
+            "model.9", "notes.txt", "state", "state.5", "state.9"]
+        assert "manifest.3.json" in out["removed"]
+        assert "state.99.tmp" in out["removed"]
+
+    def test_sweep_never_raises_on_unremovable(self, tmp_path,
+                                               monkeypatch):
+        """GC failures warn and move on — retention must never take
+        down the checkpoint writer."""
+        from bigdl_tpu.elastic import manifest as m
+
+        def member(name):
+            (tmp_path / name).write_bytes(b"x")
+
+        for neval in (2, 5):
+            member(f"model.{neval}")
+            member(f"state.{neval}")
+            m.write_manifest(
+                m.build_manifest(neval=neval, epoch=1,
+                                 model_file=f"model.{neval}",
+                                 state_file=f"state.{neval}"),
+                str(tmp_path / f"manifest.{neval}.json"))
+
+        def bad_remove(path):
+            raise OSError("immutable bit set")
+        monkeypatch.setattr(m, "_remove", bad_remove)
+        out = m.sweep_checkpoints(str(tmp_path), keep=1)
+        assert out["kept"] == [5]
+        assert out["removed"] == []            # nothing actually went

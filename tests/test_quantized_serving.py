@@ -1,0 +1,232 @@
+"""int8 quantized serving parity + byte accounting
+(bigdl_tpu/serving/quantized.py; ISSUE 15).
+
+The documented tolerances, pinned:
+
+- int8-dense and int8-interpret-paged decode see IDENTICAL quantized
+  inputs, so their outputs are EXACTLY equal (the quantization error
+  cannot differ between attention paths);
+- int8 vs fp32 greedy decode agrees on (nearly) every token on the
+  tiny test model — the codec's per-row amax/127 scale bounds the
+  logit perturbation;
+- the static byte accounting (``quantized_byte_report``, the
+  ``serving_decode_hbm_bytes`` int8 receipt) shows >= 3x at the bench
+  probe's geometry (head_dim 64). Tiny head_dims carry proportionally
+  more scale overhead — the bound is geometry-dependent and the tests
+  say so.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.models.transformer.serving import (PagedKVCache,
+                                                  paged_decode,
+                                                  paged_prefill)
+from bigdl_tpu.serving.quantized import (QuantizedKVCache,
+                                         dequantize_params,
+                                         is_quantized_leaf,
+                                         paged_decode_q8,
+                                         paged_prefill_q8,
+                                         quantize_params,
+                                         quantized_byte_report)
+
+V = 32
+
+
+def _lm(seed=3, d_model=32, **kw):
+    m = TransformerLM(V, d_model=d_model, num_heads=4, num_layers=2,
+                      max_len=64, **kw)
+    m.materialize(jax.random.PRNGKey(seed))
+    m.evaluate()
+    return m
+
+
+def _prompts(lengths, seed=2):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(1, V + 1, size=(n,))) for n in lengths]
+
+
+def _cache_for(model, *, num_pages=64, page_size=4):
+    meta = model.lm_meta
+    return PagedKVCache(meta["num_layers"], num_pages=num_pages,
+                        page_size=page_size,
+                        kv_heads=meta.get("num_kv_heads")
+                        or meta["num_heads"],
+                        head_dim=meta["d_model"] // meta["num_heads"])
+
+
+class TestParamCodec:
+    def test_structure_and_roundtrip(self):
+        model = _lm()
+        qparams = quantize_params(model.params)
+        flat_q = jax.tree_util.tree_leaves(
+            qparams, is_leaf=is_quantized_leaf)
+        quantized = [x for x in flat_q if is_quantized_leaf(x)]
+        passthrough = [x for x in flat_q if not is_quantized_leaf(x)]
+        assert quantized, "no 2-D leaf was quantized"
+        # 1-D leaves (biases, LayerNorm gains) pass through untouched
+        assert any(np.asarray(x).ndim == 1 for x in passthrough)
+        for node in quantized:
+            assert node["q"].dtype == jnp.int8
+            assert node["s"].shape == node["q"].shape[:-1]
+
+        back = dequantize_params(qparams)
+        worst = 0.0
+        for want, got in zip(jax.tree_util.tree_leaves(model.params),
+                             jax.tree_util.tree_leaves(back)):
+            err = float(jnp.max(jnp.abs(jnp.asarray(want, jnp.float32)
+                                        - got)))
+            # codec bound: half a quantization step per element
+            amax = float(jnp.max(jnp.abs(want)))
+            assert err <= amax / 127 + 1e-6
+            worst = max(worst, err)
+        assert worst > 0.0          # it did actually quantize something
+
+    def test_integer_leaves_untouched(self):
+        tree = {"w": jnp.ones((4, 4)), "steps": jnp.arange(5)}
+        q = quantize_params(tree)
+        assert is_quantized_leaf(q["w"])
+        assert q["steps"].dtype == jnp.int32
+
+    def test_is_quantized_leaf(self):
+        assert is_quantized_leaf({"q": 1, "s": 2})
+        assert not is_quantized_leaf({"q": 1})
+        assert not is_quantized_leaf({"q": 1, "s": 2, "x": 3})
+        assert not is_quantized_leaf([1, 2])
+
+
+class TestQuantizedKVCache:
+    def test_geometry_and_allocator_delegation(self):
+        model = _lm()
+        cache = _cache_for(model, num_pages=16)
+        qc = QuantizedKVCache(cache)
+        assert (qc.num_pages, qc.page_size) == (16, 4)
+        assert qc.num_layers == cache.num_layers
+        pages = qc.alloc(12)
+        # ONE allocator: the q8 alloc is visible through the source
+        assert qc.pages_free == cache.pages_free == 16 - 3
+        qc.free(pages)
+        assert cache.pages_free == 16
+
+    def test_at_rest_bytes_shrink(self):
+        model = _lm()
+        cache = _cache_for(model)
+        fp32 = sum(int(np.prod(p.shape)) * 4
+                   for p in (*cache.kp, *cache.vp))
+        qc = QuantizedKVCache(cache)
+        assert qc.nbytes < fp32 / 2.5        # head_dim 8: scale-heavy
+
+    def test_dequantize_into_roundtrip(self):
+        """A freshly quantized pool of zeros dequantizes back exactly
+        (scale never divides by zero)."""
+        model = _lm()
+        cache = _cache_for(model, num_pages=8)
+        qc = QuantizedKVCache(cache)
+        out = qc.dequantize_into()
+        assert out is cache
+        for pool in (*out.kp, *out.vp):
+            assert float(jnp.max(jnp.abs(pool))) == 0.0
+
+
+class TestDecodeParity:
+    N_NEW = 6
+
+    def _run_fp32(self, model, prompts):
+        cache = _cache_for(model)
+        table = np.asarray([cache.alloc(24) for _ in prompts], np.int32)
+        first, lengths = paged_prefill(model, cache, table, prompts)
+        toks, _ = paged_decode(model, cache, table, lengths, first,
+                               n_new=self.N_NEW)
+        return np.asarray(first), np.asarray(toks)
+
+    def _run_q8(self, model, prompts, kernel):
+        cache = _cache_for(model)
+        table = np.asarray([cache.alloc(24) for _ in prompts], np.int32)
+        qparams = quantize_params(model.params)
+        qc = QuantizedKVCache(cache)
+        first, lengths = paged_prefill_q8(model, qparams, qc, table,
+                                          prompts, paged_kernel=kernel)
+        toks, new_len = paged_decode_q8(model, qparams, qc, table,
+                                        lengths, np.asarray(first),
+                                        self.N_NEW, paged_kernel=kernel)
+        np.testing.assert_array_equal(
+            np.asarray(new_len),
+            [len(p) + self.N_NEW for p in prompts])
+        return np.asarray(first), np.asarray(toks)
+
+    @pytest.mark.parametrize("kw", [{}, {"pos_encoding": "rope",
+                                         "num_kv_heads": 2}],
+                             ids=["learned", "rope-gqa"])
+    def test_dense_interpret_parity_and_fp32_tolerance(self, kw):
+        """ISSUE 15 acceptance: int8 parity on the dense AND
+        interpret-mode paged paths. dense == interpret EXACTLY (same
+        quantized inputs through both attention paths); vs fp32 the
+        documented tolerance is token-level — the tiny model agrees on
+        essentially every greedy token."""
+        model = _lm(seed=4, **kw)
+        prompts = _prompts([5, 11, 2])
+        f_fp, t_fp = self._run_fp32(model, prompts)
+        f_qd, t_qd = self._run_q8(model, prompts, "dense")
+        f_qi, t_qi = self._run_q8(model, prompts, "interpret")
+        np.testing.assert_array_equal(f_qd, f_qi)
+        np.testing.assert_array_equal(t_qd, t_qi)
+        np.testing.assert_array_equal(f_fp, f_qd)
+        agree = float(np.mean(t_fp == t_qd))
+        assert agree >= 0.9, (t_fp, t_qd)
+
+    def test_pool_state_carries_between_calls(self):
+        """The re-quantized pools are the NEXT call's input: two decode
+        calls of 3 tokens match one call of 6 exactly on the int8
+        path."""
+        model = _lm(seed=5)
+        prompts = _prompts([4, 7])
+        _, one_shot = self._run_q8(model, prompts, "dense")
+
+        cache = _cache_for(model)
+        table = np.asarray([cache.alloc(24) for _ in prompts], np.int32)
+        qparams = quantize_params(model.params)
+        qc = QuantizedKVCache(cache)
+        first, lengths = paged_prefill_q8(model, qparams, qc, table,
+                                          prompts, paged_kernel="dense")
+        a, lengths = paged_decode_q8(model, qparams, qc, table, lengths,
+                                     np.asarray(first), 3,
+                                     paged_kernel="dense")
+        b, _ = paged_decode_q8(model, qparams, qc, table, lengths,
+                               np.asarray(a)[:, -1], 3,
+                               paged_kernel="dense")
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(a), np.asarray(b)], axis=1),
+            one_shot)
+
+
+class TestByteReport:
+    def test_probe_geometry_clears_3x(self):
+        """The >= 3x acceptance bar, at the geometry the bench row
+        actually measures (head_dim 64, GQA kv_heads 1)."""
+        model = TransformerLM(256, d_model=256, num_heads=4,
+                              num_layers=2, max_len=64,
+                              pos_encoding="rope", num_kv_heads=1,
+                              with_log_softmax=False)
+        model.materialize(jax.random.PRNGKey(0))
+        model.evaluate()
+        cache = PagedKVCache(2, num_pages=32, page_size=4, kv_heads=1,
+                             head_dim=64)
+        rep = quantized_byte_report(model, cache)
+        assert rep["reduction"] >= 3.0, rep
+        assert rep["weight_kv_bytes_fp32"] == \
+            rep["weight_bytes_fp32"] + rep["kv_pool_bytes_fp32"]
+        assert rep["weight_kv_bytes_int8"] == \
+            rep["weight_bytes_int8"] + rep["kv_pool_bytes_int8"]
+
+    def test_tiny_geometry_documented_overhead(self):
+        """head_dim 8 pays 4 scale bytes per 8-element row: the
+        reduction is real but below 3x — the geometry dependence is a
+        documented property, not noise."""
+        model = _lm()
+        rep = quantized_byte_report(model, _cache_for(model))
+        assert 2.0 <= rep["reduction"] < 4.0
+        assert rep["weight_bytes_int8"] < rep["weight_bytes_fp32"]
+        assert rep["kv_pool_bytes_int8"] < rep["kv_pool_bytes_fp32"]
