@@ -1674,6 +1674,136 @@ def bench_autoscale_time_to_capacity(*, n_requests: int = 24,
     }
 
 
+def bench_publish_to_fleet(*, n_requests: int = 12):
+    """Continuous-deployment receipt (ISSUE 16): seconds from a newly
+    COMMITTED trainer checkpoint (manifest on disk) until 100% of a
+    2-replica serving fleet serves it — warm canary qualification
+    (pinned-prompt parity + zero compiles off the shared AOT cache),
+    then a replica-by-replica drain -> reload -> resume rollout, with
+    live traffic in flight the whole time. The drill asserts the
+    zero-downtime contract: every request submitted before, during and
+    after the publish is delivered exactly once, and the warm canary
+    spin-up pays ZERO XLA compiles. A second, parity-failing commit
+    then drills the rollback path: the canary fails and the fleet
+    stays 100% on the published version. ``value`` is the measured
+    commit-to-fleet latency (lower is better)."""
+    import tempfile
+
+    import jax
+
+    from bigdl_tpu.deploy import (CanaryConfig, PublisherConfig,
+                                  WeightPublisher,
+                                  write_model_checkpoint)
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                       generate)
+    from bigdl_tpu.observability.exporter import HealthRegistry
+    from bigdl_tpu.observability.registry import MetricRegistry
+    from bigdl_tpu.serving import (PrefixCache, ReplicaPool, Router,
+                                   SLOConfig)
+
+    vocab = 256
+
+    def _lm(seed):
+        m = TransformerLM(vocab, d_model=64, num_heads=4, num_layers=2,
+                          max_len=64, with_log_softmax=False)
+        m.materialize(jax.random.PRNGKey(seed))
+        m.evaluate()
+        return m
+
+    model, model2 = _lm(0), _lm(1)
+    host = np.random.default_rng(0)
+    prompts = [list(host.integers(1, vocab + 1,
+                                  size=(int(host.integers(5, 14)),)))
+               for _ in range(n_requests)]
+    pin = prompts[0]
+    gen = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    expected_new = [int(t) for t in np.asarray(
+        generate(model2, np.asarray([pin], np.int32), gen))[0]]
+    geo = dict(max_batch=2, num_pages=64, page_size=4,
+               max_new_tokens=8, max_burst=4)
+
+    health = HealthRegistry()
+    reg = MetricRegistry()
+    with tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "ck")
+        cache_dir = os.path.join(tmp, "aot")
+        write_model_checkpoint(ck, model, neval=1)
+        pool = ReplicaPool(model, 2, health=health, aot_cache=cache_dir,
+                           **geo)
+        router = Router(pool, slo=SLOConfig(long_prefill_tokens=64),
+                        prefix_cache=PrefixCache(min_tokens=4),
+                        registry=reg, health=health)
+        pub = WeightPublisher(
+            router, ck,
+            config=PublisherConfig(
+                CanaryConfig(prompts=[(pin, expected_new)],
+                             require_zero_compiles=True),
+                drain_timeout_s=120),
+            registry=reg, health=health)
+        try:
+            third = max(1, n_requests // 3)
+            for i in range(third):                  # before the commit
+                router.submit(f"q{i}", prompts[i])
+            router.wait_all(timeout=600)
+            # the trainer commits checkpoint N+1 mid-serving
+            write_model_checkpoint(ck, model2, neval=2)
+            for i in range(third, 2 * third):       # in flight/queued
+                router.submit(f"q{i}", prompts[i])
+            t0 = time.perf_counter()
+            report = pub.poll_once()
+            publish_s = time.perf_counter() - t0
+            if report is None or report.outcome != "ok":
+                raise RuntimeError(
+                    "publish drill did not roll the fleet: "
+                    f"{None if report is None else report.as_dict()}")
+            for i in range(2 * third, n_requests):  # after the rollout
+                router.submit(f"q{i}", prompts[i])
+            router.wait_all(timeout=600)
+            results = dict(router.finished())
+            versions = {pool[n].weight_version for n in pool.names}
+            # rollback sub-drill: commit a third checkpoint whose
+            # canary CANNOT satisfy the pinned expectation (old
+            # weights vs the v2 expectation) — the fleet must stay put
+            write_model_checkpoint(ck, model, neval=3)
+            rb = pub.poll_once()
+            rb_versions = {pool[n].weight_version for n in pool.names}
+        finally:
+            pub.close()
+            router.close()
+            pool.close()
+    if len(results) != n_requests:
+        raise RuntimeError(
+            f"publish drill dropped/duplicated work: {len(results)} "
+            f"results for {n_requests} requests")
+    if versions != {"v2"} or rb_versions != {"v2"}:
+        raise RuntimeError(
+            f"fleet not uniformly on the published version: {versions} "
+            f"after publish, {rb_versions} after rollback drill")
+    if report.canary.compiles != 0:
+        raise RuntimeError(
+            f"warm canary compiled: {report.canary.compiles} AOT "
+            "misses (expected 0 — the candidate shares every "
+            "executable)")
+    return {
+        "metric": "publish_to_fleet_secs",
+        "value": round(publish_s, 3),
+        "unit": "seconds committed checkpoint -> 100% of fleet "
+                "(2 replicas, warm canary)",
+        "canary_compiles": report.canary.compiles,
+        "replicas_rolled": len(report.rolled),
+        "rollback_drill_outcome": rb.outcome,
+        "rollback_kept_fleet": rb_versions == {"v2"},
+        "fleet_version": sorted(versions)[0],
+        "n_requests": n_requests,
+        "conserved": len(results) == n_requests,
+        "aot_hits": int(pool.aot.hits),
+        "aot_misses": int(pool.aot.misses),
+        "geometry": ("d64 L2 2 replicas + canary, "
+                     f"{n_requests} reqs, 2 slots x 64 pages x 4"),
+    }
+
+
 def _decode_hbm_probe_main(geometry_json: str):
     """--decode-hbm-probe subprocess entry: run the static accounting
     on the CPU backend and emit the JSON payload. ``geometry_json``
@@ -1728,7 +1858,8 @@ GATE_DEFAULT_MIN_RATIO = 0.8
 # override with an explicit "direction".
 _GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
                          "collective_wire_bytes_per_step",
-                         "autoscale_time_to_capacity"}
+                         "autoscale_time_to_capacity",
+                         "publish_to_fleet_secs"}
 
 GATE_EXIT_CODE = 4
 
@@ -2067,7 +2198,7 @@ def _run(args):
                 "compile_cold_start", "serving_decode_hbm_bytes",
                 "train_peak_hbm_bytes", "multichip_scaling",
                 "pipeline_bubble_fraction", "elastic_resume_secs",
-                "autoscale_time_to_capacity"]
+                "autoscale_time_to_capacity", "publish_to_fleet_secs"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -2076,7 +2207,8 @@ def _run(args):
              "collective_wire_bytes_per_step", "compile_cold_start",
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
              "multichip_scaling", "pipeline_bubble_fraction",
-             "elastic_resume_secs", "autoscale_time_to_capacity"}
+             "elastic_resume_secs", "autoscale_time_to_capacity",
+             "publish_to_fleet_secs"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -2131,6 +2263,7 @@ def _run(args):
         "pipeline_bubble_fraction": bench_pipeline_bubble,
         "elastic_resume_secs": bench_elastic_resume_secs,
         "autoscale_time_to_capacity": bench_autoscale_time_to_capacity,
+        "publish_to_fleet_secs": bench_publish_to_fleet,
     }
     rows_out: list[dict] = []
     headline_failed = False

@@ -1,0 +1,49 @@
+"""bigdl_tpu.deploy — continuous deployment into the serving fleet.
+
+The control plane that closes the train-to-serve loop (ROADMAP item 1;
+arXiv:1804.05839's one-cluster pipeline): a trainer keeps committing
+elastic checkpoints, the fleet keeps serving, and the
+:class:`WeightPublisher` thread carries each new commit into production
+with zero downtime — warm canary qualification, replica-by-replica
+rollout with version-tagged in-flight migration, automatic rollback.
+Three modules:
+
+- ``version``   — :class:`WeightManifest`, the versioned ready-to-serve
+  weight set loaded from a manifest-committed checkpoint (optionally
+  through the int8 round-trip), plus the checkpoint-writing helper
+  drills and offline converters publish through.
+- ``canary``    — :func:`qualify`: pinned-prompt parity + latency SLO +
+  zero-compile gates over a quarantined warm replica;
+  :class:`ShadowTap` mirrors live traffic for output agreement.
+- ``publisher`` — :class:`WeightPublisher`, the poll -> load -> canary
+  -> roll -> (rollback) loop, with ``publisher_*`` metrics, trace
+  instants, flight-recorder events and a liveness check.
+
+Quick start::
+
+    pub = WeightPublisher(router, "ckpts/", config=PublisherConfig(
+        CanaryConfig(prompts=[(pinned_prompt, expected_tokens)],
+                     slo=SLOConfig(), require_zero_compiles=True)))
+    pub.start()            # rolls every newer checkpoint the trainer
+    ...                    # commits; pub.history has the outcomes
+    pub.close()
+
+HOST-ONLY CONTRACT: nothing in this package imports jax at module top
+level (jaxlint JX5) — deployment is host orchestration; device work
+happens inside the batchers the pool owns. docs/DEPLOYMENT.md covers
+architecture, qualification gates, version-skew semantics and the
+rollback runbook.
+"""
+from bigdl_tpu.deploy.canary import (CanaryConfig, CanaryReport,
+                                     ShadowTap, qualify, replay)
+from bigdl_tpu.deploy.publisher import (PublisherConfig, PublishReport,
+                                        WeightPublisher)
+from bigdl_tpu.deploy.version import (WeightManifest,
+                                      load_weight_version,
+                                      version_string,
+                                      write_model_checkpoint)
+
+__all__ = ["CanaryConfig", "CanaryReport", "ShadowTap", "qualify",
+           "replay", "PublisherConfig", "PublishReport",
+           "WeightPublisher", "WeightManifest", "load_weight_version",
+           "version_string", "write_model_checkpoint"]

@@ -1,0 +1,300 @@
+"""Canary qualification: prove candidate weights before they roll.
+
+The publisher spins the candidate up as ONE extra replica
+(``pool.add_replica(warm=True, model=candidate)`` — zero compiles off
+the pool's shared AOT cache, because a same-geometry model re-uses
+every executable) and this module decides pass/fail from three gates:
+
+- **pinned-prompt parity** (:func:`replay`): every configured prompt is
+  replayed on the canary and its greedy continuation compared
+  token-for-token against the expected output. Greedy decode is a pure
+  function of (params, KV, last token), so ANY mismatch means the
+  weights do not behave as qualified — not noise.
+- **latency SLO**: TTFT / decode-token p99 read off the canary's own
+  histogram snapshots (the replica is freshly built, so its histograms
+  contain exactly the qualification traffic) vs the fleet's
+  :class:`~bigdl_tpu.serving.slo.SLOConfig` targets.
+- **zero compiles** (optional): the pool AOT cache's ``misses`` counter
+  must not move while the canary spins up and replays — a miss means
+  the candidate changed geometry and every rolled replica would pay an
+  XLA compile in production.
+
+:class:`ShadowTap` adds live-traffic shadowing: it rides the router's
+``on_submit``/``on_result`` observer taps, mirrors a deterministic
+fraction of accepted prompts onto the canary (distinct request ids, so
+the primary fleet's exactly-once accounting is untouched), and scores
+agreement between primary and canary outputs. Shadowing compares
+OUTPUTS only — shadow results are never returned to callers.
+
+HOST-ONLY CONTRACT (jaxlint JX5): no jax imports — qualification is
+pure host orchestration over the replica API.
+"""
+from __future__ import annotations
+
+import time
+
+from bigdl_tpu.serving.slo import SLOConfig, percentile
+
+__all__ = ["CanaryConfig", "CanaryReport", "ShadowTap", "qualify",
+           "replay"]
+
+_CANARY_NS = "__canary__"
+_SHADOW_NS = "__shadow__"
+
+
+class CanaryConfig:
+    """Qualification gates for one publish.
+
+    - ``prompts``: the pinned prompt set — ``(prompt_tokens,
+      expected_tokens)`` pairs; ``expected_tokens=None`` replays for
+      latency only (no parity check on that prompt).
+    - ``slo``: latency targets the canary must meet (None skips the
+      latency gate).
+    - ``require_zero_compiles``: fail if the shared AOT cache records
+      any miss during canary spin-up + replay.
+    - ``shadow_fraction`` / ``min_shadow_samples`` /
+      ``min_shadow_agreement``: mirror that fraction of live traffic
+      onto the canary and require the agreement rate over at least
+      that many compared pairs (0.0 fraction disables shadowing).
+    - ``timeout_s``: replay/shadow wall-clock budget.
+    """
+
+    def __init__(self, prompts=(), *, slo: SLOConfig | None = None,
+                 require_zero_compiles: bool = False,
+                 shadow_fraction: float = 0.0,
+                 min_shadow_samples: int = 1,
+                 min_shadow_agreement: float = 1.0,
+                 timeout_s: float = 60.0):
+        self.prompts = [(list(p), None if e is None else list(e))
+                        for p, e in prompts]
+        self.slo = slo
+        self.require_zero_compiles = bool(require_zero_compiles)
+        if not 0.0 <= float(shadow_fraction) <= 1.0:
+            raise ValueError(f"shadow_fraction must be in [0, 1], got "
+                             f"{shadow_fraction}")
+        self.shadow_fraction = float(shadow_fraction)
+        self.min_shadow_samples = int(min_shadow_samples)
+        self.min_shadow_agreement = float(min_shadow_agreement)
+        self.timeout_s = float(timeout_s)
+
+
+class CanaryReport:
+    """The qualification verdict: ``passed`` plus one human-readable
+    reason per failed gate and the raw per-gate numbers."""
+
+    __slots__ = ("passed", "reasons", "parity", "latency", "compiles",
+                 "shadow")
+
+    def __init__(self, passed, reasons, *, parity=None, latency=None,
+                 compiles=None, shadow=None):
+        self.passed = bool(passed)
+        self.reasons = list(reasons)
+        self.parity = parity
+        self.latency = latency
+        self.compiles = compiles
+        self.shadow = shadow
+
+    def as_dict(self) -> dict:
+        return {"passed": self.passed, "reasons": list(self.reasons),
+                "parity": self.parity, "latency": self.latency,
+                "compiles": self.compiles, "shadow": self.shadow}
+
+    def __repr__(self):
+        verdict = "PASS" if self.passed else "FAIL"
+        return f"CanaryReport({verdict}, reasons={self.reasons!r})"
+
+
+def replay(replica, prompts, *, timeout_s: float = 60.0) -> dict:
+    """Replay ``prompts`` (list of token lists) on a DETACHED replica
+    (one the router holds no hooks on: results land in the batcher's
+    own ``finished()`` buffer) and return ``{index: tokens}``. The
+    replica's driver thread does the stepping; this call just waits for
+    idle."""
+    for i, prompt in enumerate(prompts):
+        replica.submit((_CANARY_NS, i), list(prompt))
+    if not replica.wait_idle(timeout_s):
+        raise TimeoutError(
+            f"canary {replica.name} did not finish its "
+            f"{len(prompts)}-prompt replay in {timeout_s}s")
+    out = {}
+    with replica.lock:
+        done = replica.batcher.finished()
+    for rid, toks in done:
+        if isinstance(rid, tuple) and rid[0] == _CANARY_NS:
+            out[rid[1]] = list(toks)
+    return out
+
+
+def qualify(replica, config: CanaryConfig, *, aot=None,
+            aot_misses_before: int | None = None,
+            shadow_report: dict | None = None) -> CanaryReport:
+    """Run the gates (module docstring) against ``replica`` and render
+    the verdict. ``aot``/``aot_misses_before`` bound the zero-compile
+    window (pass the pool's shared cache and its ``misses`` value from
+    BEFORE ``add_replica``); ``shadow_report`` is a
+    :meth:`ShadowTap.report` dict when live shadowing ran."""
+    reasons = []
+
+    replayed = replay(replica, [p for p, _ in config.prompts],
+                      timeout_s=config.timeout_s)
+    mismatches = []
+    checked = 0
+    for i, (_prompt, expected) in enumerate(config.prompts):
+        if expected is None:
+            continue
+        checked += 1
+        got = replayed.get(i)
+        if got != expected:
+            mismatches.append({"prompt_index": i, "expected": expected,
+                               "got": got})
+    parity = {"replayed": len(replayed), "checked": checked,
+              "mismatched": len(mismatches), "mismatches": mismatches}
+    if mismatches:
+        reasons.append(
+            f"parity: {len(mismatches)}/{checked} pinned prompts "
+            "diverged from their expected greedy continuation")
+
+    latency = None
+    if config.slo is not None:
+        ttft = percentile(
+            replica.histogram_snapshot("serving_ttft_seconds"), 0.99)
+        dec = percentile(
+            replica.histogram_snapshot("serving_decode_token_seconds"),
+            0.99)
+        latency = {"ttft_p99_s": ttft, "decode_token_p99_s": dec}
+        if ttft is not None and ttft > config.slo.ttft_p99_s:
+            reasons.append(f"slo: canary ttft p99 {ttft:.4f}s > target "
+                           f"{config.slo.ttft_p99_s}s")
+        if dec is not None and dec > config.slo.decode_token_p99_s:
+            reasons.append(
+                f"slo: canary decode-token p99 {dec:.4f}s > target "
+                f"{config.slo.decode_token_p99_s}s")
+
+    compiles = None
+    if aot is not None and aot_misses_before is not None:
+        compiles = int(aot.misses) - int(aot_misses_before)
+        if config.require_zero_compiles and compiles > 0:
+            reasons.append(
+                f"aot: canary spin-up paid {compiles} compile(s) — the "
+                "candidate's geometry misses the shared executable "
+                "cache, so every rolled replica would recompile")
+
+    if config.shadow_fraction > 0.0:
+        sr = shadow_report or {"samples": 0, "agreed": 0,
+                               "agreement": None}
+        if sr["samples"] < config.min_shadow_samples:
+            reasons.append(
+                f"shadow: only {sr['samples']} compared pairs "
+                f"(need >= {config.min_shadow_samples})")
+        elif sr["agreement"] < config.min_shadow_agreement:
+            reasons.append(
+                f"shadow: agreement {sr['agreement']:.3f} < required "
+                f"{config.min_shadow_agreement:.3f} over "
+                f"{sr['samples']} pairs")
+        shadow = sr
+    else:
+        shadow = shadow_report
+
+    return CanaryReport(not reasons, reasons, parity=parity,
+                        latency=latency, compiles=compiles,
+                        shadow=shadow)
+
+
+class ShadowTap:
+    """Mirror a deterministic fraction of live router traffic onto a
+    canary replica and score output agreement (module docstring).
+
+    Installs itself on ``router.on_submit``/``router.on_result`` at
+    construction and restores the previous taps on :meth:`close` (use
+    as a context manager). Sampling is counter-based — every accepted
+    prompt advances a phase accumulator, so ``fraction=0.25`` shadows
+    exactly every 4th request with no RNG. A saturated canary drops the
+    shadow copy rather than back-pressuring live traffic."""
+
+    def __init__(self, router, replica, *, fraction: float = 0.1,
+                 max_shadow: int = 256):
+        if not 0.0 < float(fraction) <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got "
+                             f"{fraction}")
+        self.router = router
+        self.replica = replica
+        self.fraction = float(fraction)
+        self.max_shadow = int(max_shadow)
+        self._n_seen = 0
+        self._n_shadowed = 0
+        self._n_dropped = 0
+        self._primary: dict = {}    # rid -> tokens (shadowed only)
+        self._awaited: set = set()
+        self._prev_submit = router.on_submit
+        self._prev_result = router.on_result
+        self._prev_complete = replica.batcher.on_complete
+        self._canary: dict = {}     # rid -> tokens
+        replica.batcher.on_complete = self._on_canary_complete
+        router.on_submit = self._on_submit
+        router.on_result = self._on_result
+
+    # -- hooks --
+    def _on_submit(self, rid, prompt):
+        if self._prev_submit is not None:
+            self._prev_submit(rid, prompt)
+        self._n_seen += 1
+        take = (int(self._n_seen * self.fraction)
+                > int((self._n_seen - 1) * self.fraction))
+        if not take or self._n_shadowed >= self.max_shadow:
+            return
+        try:
+            self.replica.submit((_SHADOW_NS, rid), list(prompt))
+        except Exception:
+            self._n_dropped += 1      # canary saturated/draining: skip
+            return
+        self._n_shadowed += 1
+        self._awaited.add(rid)
+
+    def _on_result(self, rid, toks):
+        if self._prev_result is not None:
+            self._prev_result(rid, toks)
+        if rid in self._awaited:
+            self._primary[rid] = list(toks)
+
+    def _on_canary_complete(self, rid, toks):
+        if isinstance(rid, tuple) and rid[0] == _SHADOW_NS:
+            self._canary[rid[1]] = list(toks)
+        elif self._prev_complete is not None:
+            self._prev_complete(rid, toks)
+
+    # -- results --
+    def wait(self, timeout_s: float = 30.0) -> None:
+        """Block until every shadow copy submitted so far completed."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(r in self._canary for r in list(self._awaited)):
+                return
+            time.sleep(0.005)
+        missing = sum(r not in self._canary
+                      for r in list(self._awaited))
+        raise TimeoutError(
+            f"{missing} shadow copies still running after {timeout_s}s")
+
+    def report(self) -> dict:
+        """Agreement over pairs where BOTH outputs arrived."""
+        pairs = [(self._primary[r], self._canary[r])
+                 for r in list(self._awaited)
+                 if r in self._primary and r in self._canary]
+        agreed = sum(a == b for a, b in pairs)
+        return {"seen": self._n_seen, "shadowed": self._n_shadowed,
+                "dropped": self._n_dropped, "samples": len(pairs),
+                "agreed": agreed,
+                "agreement": (agreed / len(pairs)) if pairs else None}
+
+    def close(self) -> None:
+        """Detach: restore the router taps and the canary hook."""
+        self.router.on_submit = self._prev_submit
+        self.router.on_result = self._prev_result
+        self.replica.batcher.on_complete = self._prev_complete
+
+    def __enter__(self) -> "ShadowTap":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

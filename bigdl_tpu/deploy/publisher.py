@@ -1,0 +1,492 @@
+"""WeightPublisher: continuous deployment for the serving fleet.
+
+The control loop that closes BigDL's train-to-serve story (ROADMAP
+item 1; arXiv:1804.05839's one-cluster pipeline, BigDL 2.0's
+production framing in arXiv:2204.01715): a trainer keeps committing
+checkpoints (``elastic/`` — manifest written LAST, so torn snapshots
+are never eligible) while the fleet keeps serving, and this thread
+carries each new commit into production with zero downtime:
+
+1. **poll** ``latest_checkpoint(dir)`` every few seconds (the
+   mtime+size fast path re-parses only changed manifests);
+2. **load** the new weights into a versioned
+   :class:`~bigdl_tpu.deploy.version.WeightManifest` (optionally
+   through the int8 round-trip);
+3. **canary**: quarantine a name at the router, spin it up WARM on the
+   candidate weights (``pool.add_replica(warm=True, model=...)`` —
+   zero compiles off the shared AOT cache), and qualify it:
+   pinned-prompt parity + latency SLO + optional live-traffic
+   shadowing (``deploy/canary.py``);
+4. **roll** the fleet replica by replica on pass:
+   ``router.drain(name, policy=...)`` (each in-flight request either
+   finishes on the old weights or migrates its KV — bitwise — to an
+   old-version survivor) -> ``Replica.set_weights`` -> ``resume``;
+5. **rollback** on any failure: a failed canary never touches the
+   fleet, and a mid-rollout error or SLO breach re-installs the prior
+   version on every already-rolled replica before the publisher
+   reports — the fleet is never left partially downgraded.
+
+Version-skew contract (docs/DEPLOYMENT.md): every replica and every
+exported KV snapshot carries a ``weight_version``; the router only
+places a snapshot on a matching replica, the batcher re-validates on
+adopt, and a snapshot whose version no longer exists anywhere restarts
+from its prompt — every request completes exactly once, attributable
+to exactly one version.
+
+Observability: ``publisher_*`` metrics, ``publish``-kind trace
+instants and flight-recorder events, a ``weight_publisher`` liveness
+check, and a bounded ``history`` of publish outcomes (the postmortem
+log).
+
+HOST-ONLY CONTRACT (jaxlint JX5): no module-level jax import; device
+work happens inside the batchers the pool already owns.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from bigdl_tpu.deploy.canary import CanaryConfig, ShadowTap, qualify
+from bigdl_tpu.deploy.version import (WeightManifest,
+                                      load_weight_version,
+                                      version_string)
+from bigdl_tpu.observability import trace
+from bigdl_tpu.observability.exporter import default_health
+from bigdl_tpu.observability.registry import default_registry
+from bigdl_tpu.serving.autoscaler import _delta_snapshot
+from bigdl_tpu.serving.slo import percentile
+
+__all__ = ["PublisherConfig", "PublishReport", "WeightPublisher"]
+
+logger = logging.getLogger(__name__)
+
+
+class _RollbackSignal(Exception):
+    """Internal: a qualification-style failure DURING the rollout."""
+
+
+class PublisherConfig:
+    """Knobs for one :class:`WeightPublisher`.
+
+    - ``canary``: the qualification gates
+      (:class:`~bigdl_tpu.deploy.canary.CanaryConfig`).
+    - ``poll_interval_s``: checkpoint-directory poll cadence.
+    - ``quantize``: publish the int8-at-rest reconstruction of each
+      checkpoint instead of raw f32 (``serving/quantized.py``).
+    - ``canary_name``: the quarantined replica name the canary uses.
+    - ``slo``: when set, a mid-rollout SLO watch — after each replica
+      swap the ROLLOUT-WINDOW fleet p99s (histogram deltas since the
+      rollout began) are checked against these targets and a breach
+      triggers rollback.
+    - ``migrate_policy``: ``policy(request_id) -> "finish"|"migrate"``
+      for in-flight requests on a draining replica (None = all finish
+      on the old weights). "migrate" exports the KV mid-decode to an
+      old-version survivor (bitwise continuation); the publisher forces
+      "finish" when no survivor of that version remains.
+    - ``drain_timeout_s`` / ``liveness_grace_s``: drain budget per
+      replica; how stale the poll loop may go before the
+      ``weight_publisher`` health check flips.
+    """
+
+    def __init__(self, canary: CanaryConfig | None = None, *,
+                 poll_interval_s: float = 2.0, quantize: bool = False,
+                 canary_name: str = "canary", slo=None,
+                 migrate_policy=None, drain_timeout_s: float = 60.0,
+                 liveness_grace_s: float = 30.0):
+        self.canary = canary if canary is not None else CanaryConfig()
+        self.poll_interval_s = float(poll_interval_s)
+        self.quantize = bool(quantize)
+        self.canary_name = str(canary_name)
+        self.slo = slo
+        self.migrate_policy = migrate_policy
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.liveness_grace_s = float(liveness_grace_s)
+
+
+class PublishReport:
+    """What one publish attempt did — the ``history`` entry."""
+
+    __slots__ = ("outcome", "version", "neval", "canary", "rolled",
+                 "rolled_back", "duration_s", "error")
+
+    def __init__(self, outcome, version, neval, *, canary=None,
+                 rolled=(), rolled_back=(), duration_s=0.0,
+                 error=None):
+        self.outcome = outcome        # ok|canary_failed|rolled_back|error
+        self.version = version
+        self.neval = int(neval)
+        self.canary = canary          # CanaryReport | None
+        self.rolled = list(rolled)
+        self.rolled_back = list(rolled_back)
+        self.duration_s = float(duration_s)
+        self.error = error
+
+    def as_dict(self) -> dict:
+        return {"outcome": self.outcome, "version": self.version,
+                "neval": self.neval,
+                "canary": (self.canary.as_dict()
+                           if self.canary is not None else None),
+                "rolled": list(self.rolled),
+                "rolled_back": list(self.rolled_back),
+                "duration_s": self.duration_s,
+                "error": self.error}
+
+    def __repr__(self):
+        return (f"PublishReport({self.outcome!r}, {self.version!r}, "
+                f"rolled={self.rolled}, duration_s="
+                f"{self.duration_s:.3f})")
+
+
+class WeightPublisher:
+    """See module docstring. ``router`` fronts the pool being rolled;
+    ``checkpoint_dir`` is the trainer's commit directory.
+
+    The fleet's CURRENT version at construction: the newest manifest
+    already under ``checkpoint_dir`` is assumed to be what the fleet
+    was started from (the operator loaded it to build the pool) and
+    becomes the baseline — only NEWER commits publish. No manifest
+    means an unversioned fleet, stamped ``v0``. Every existing replica
+    that carries no version is stamped with the baseline so snapshot
+    version checks bite from the first publish on.
+
+    ``start()``/``close()`` run the poll loop on a daemon thread;
+    ``poll_once()`` runs one iteration synchronously (tests, drills,
+    and supervisors that already own a loop)."""
+
+    def __init__(self, router, checkpoint_dir: str, *,
+                 config: PublisherConfig | None = None, registry=None,
+                 health=None, recorder=None):
+        # local import: elastic.manifest is host-only too, but keep the
+        # publisher constructible without the elastic package loaded
+        from bigdl_tpu.elastic.manifest import latest_checkpoint
+        self._latest_checkpoint = latest_checkpoint
+        self.router = router
+        self.pool = router.pool
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.config = config if config is not None else PublisherConfig()
+        self._poll_cache: dict = {}
+        self.history: deque = deque(maxlen=64)
+
+        reg = default_registry() if registry is None else registry
+        self._m_polls = reg.counter(
+            "publisher_polls_total",
+            "checkpoint-directory polls (fast path included)")
+        self._m_publishes = reg.counter(
+            "publisher_publishes_total",
+            "publish attempts by outcome",
+            labelnames=("outcome",))
+        self._m_rollbacks = reg.counter(
+            "publisher_rollbacks_total",
+            "publishes that rolled the fleet back to the prior version")
+        self._m_rolled = reg.counter(
+            "publisher_replicas_rolled_total",
+            "replica weight swaps performed (rollbacks included)")
+        self._g_neval = reg.gauge(
+            "publisher_current_neval",
+            "checkpoint neval the fleet currently serves")
+        self._g_inprog = reg.gauge(
+            "publisher_rollout_in_progress",
+            "1 while a canary/rollout is running")
+
+        self._recorder = recorder
+        self._health = health if health is not None else default_health()
+        self._health.register("weight_publisher", self._alive,
+                              kind="liveness")
+
+        # baseline: what the fleet already serves (docstring)
+        man = self._latest_checkpoint(self.checkpoint_dir,
+                                      cache=self._poll_cache)
+        neval = -1 if man is None else int(man["neval"])
+        version = "v0" if man is None else version_string(neval)
+        self.current = WeightManifest(version, self.pool.model,
+                                      neval=neval,
+                                      source=self.checkpoint_dir,
+                                      manifest=man)
+        for rep in self.pool:
+            if rep.weight_version is None:
+                rep.set_weights(weight_version=version)
+        self.pool.set_default_model(self.pool.model,
+                                    weight_version=version)
+        self._g_neval.set(neval)
+
+        self._stop = False
+        self._started = False
+        self._last_poll = time.monotonic()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-weight-publisher", daemon=True)
+
+    # -- lifecycle --
+    def start(self) -> "WeightPublisher":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._started:
+            self._thread.join(timeout)
+        self._health.unregister("weight_publisher")
+
+    def __enter__(self) -> "WeightPublisher":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _run(self):
+        while not self._stop:
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("weight publisher poll failed")
+            self._wake.wait(self.config.poll_interval_s)
+            self._wake.clear()
+
+    def _alive(self):
+        if self._started and not self._thread.is_alive() \
+                and not self._stop:
+            return False, "publisher thread died"
+        age = time.monotonic() - self._last_poll
+        if self._started and age > max(self.config.liveness_grace_s,
+                                       2 * self.config.poll_interval_s):
+            return False, (f"no poll for {age:.1f}s (serving "
+                           f"{self.current.version})")
+        return True, (f"serving {self.current.version} "
+                      f"(neval={self.current.neval}); last poll "
+                      f"{age:.1f}s ago")
+
+    # -- the loop body --
+    def poll_once(self):
+        """One poll: return ``None`` when nothing new is committed,
+        else the :class:`PublishReport` of the publish it triggered."""
+        self._m_polls.inc()
+        self._last_poll = time.monotonic()
+        man = self._latest_checkpoint(self.checkpoint_dir,
+                                      cache=self._poll_cache)
+        if man is None or int(man["neval"]) <= self.current.neval:
+            return None
+        return self.publish(man)
+
+    def publish(self, man: dict) -> PublishReport:
+        """Qualify and roll the checkpoint behind manifest ``man``
+        (module docstring steps 2-5). Never raises for a qualification
+        or rollout failure — the report's ``outcome`` says what
+        happened; only the poll loop's own crash-fence sees unexpected
+        errors."""
+        t0 = time.monotonic()
+        neval = int(man["neval"])
+        version = version_string(neval)
+        old = self.current
+        self._g_inprog.set(1)
+        trace.instant("publish detected", cat="deploy", neval=neval,
+                      version=version, current=old.version)
+        self._record("detected", neval=neval, version=version)
+        report = None
+        try:
+            report = self._publish_inner(man, neval, version, old, t0)
+        except Exception as e:       # load/spin-up/unexpected failure
+            logger.exception("publish of %s failed", version)
+            report = PublishReport("error", version, neval,
+                                   duration_s=time.monotonic() - t0,
+                                   error=f"{type(e).__name__}: {e}")
+        finally:
+            self._g_inprog.set(0)
+        self._m_publishes.inc(outcome=report.outcome)
+        if report.outcome in ("canary_failed", "rolled_back"):
+            self._m_rollbacks.inc()
+        self.history.append(report)
+        trace.instant("publish finished", cat="deploy",
+                      outcome=report.outcome, version=version,
+                      duration_s=round(report.duration_s, 4))
+        self._record(report.outcome, version=version, neval=neval,
+                     rolled=len(report.rolled),
+                     duration_s=round(report.duration_s, 4))
+        return report
+
+    def _publish_inner(self, man, neval, version, old,
+                       t0) -> PublishReport:
+        cfg = self.config
+        wm = load_weight_version(self.checkpoint_dir, neval=neval,
+                                 quantize=cfg.quantize)
+        aot_before = (int(self.pool.aot.misses)
+                      if self.pool.aot is not None else None)
+        cname = cfg.canary_name
+        # fence BEFORE the replica exists: no dispatcher window
+        self.router.quarantine(cname)
+        canary = None
+        tap = None
+        try:
+            canary = self.pool.add_replica(
+                cname, warm=True, model=wm.model,
+                weight_version=wm.version)
+            trace.instant("canary up", cat="deploy", version=version,
+                          warm=True)
+            shadow_report = None
+            if cfg.canary.shadow_fraction > 0.0:
+                tap = ShadowTap(self.router, canary,
+                                fraction=cfg.canary.shadow_fraction)
+                self._shadow_window(tap)
+                try:
+                    tap.wait(cfg.canary.timeout_s)
+                except TimeoutError:
+                    pass              # score whatever pairs completed
+                shadow_report = tap.report()
+                tap.close()
+                tap = None
+            verdict = qualify(canary, cfg.canary, aot=self.pool.aot,
+                              aot_misses_before=aot_before,
+                              shadow_report=shadow_report)
+            trace.instant("canary verdict", cat="deploy",
+                          version=version, passed=verdict.passed,
+                          reasons=len(verdict.reasons))
+            if not verdict.passed:
+                logger.warning("canary for %s failed: %s", version,
+                               "; ".join(verdict.reasons))
+                return PublishReport(
+                    "canary_failed", version, neval, canary=verdict,
+                    duration_s=time.monotonic() - t0,
+                    error="; ".join(verdict.reasons))
+            return self._roll_fleet(wm, old, verdict, t0)
+        finally:
+            if tap is not None:
+                tap.close()
+            if canary is not None and cname in self.pool.replicas:
+                self._retire_canary(canary)
+            self.router.unquarantine(cname)
+
+    def _shadow_window(self, tap) -> None:
+        """Hold the canary in shadow mode until enough live requests
+        were mirrored (or the qualification budget runs out)."""
+        cfg = self.config
+        deadline = time.monotonic() + cfg.canary.timeout_s
+        while (time.monotonic() < deadline
+               and tap._n_shadowed < cfg.canary.min_shadow_samples):
+            time.sleep(0.005)
+
+    def _retire_canary(self, canary) -> None:
+        try:
+            canary.drain_begin()
+            canary.wait_idle(self.config.drain_timeout_s)
+            self.pool.remove_replica(canary.name)
+        except Exception:
+            logger.exception("could not retire canary %s", canary.name)
+
+    # -- rollout --
+    def _roll_fleet(self, wm, old, verdict, t0) -> PublishReport:
+        cfg = self.config
+        fleet = [n for n in self.pool.names if n != cfg.canary_name]
+        baseline = {}
+        if cfg.slo is not None:
+            baseline = {
+                n: (self.pool[n].histogram_snapshot(
+                        "serving_ttft_seconds"),
+                    self.pool[n].histogram_snapshot(
+                        "serving_decode_token_seconds"))
+                for n in fleet}
+        rolled = []
+        try:
+            for name in fleet:
+                self._install(name, wm)
+                rolled.append(name)
+                self._m_rolled.inc()
+                trace.instant("replica rolled", cat="deploy",
+                              replica=name, version=wm.version)
+                breach = self._slo_breach(rolled, baseline)
+                if breach:
+                    raise _RollbackSignal(breach)
+        except Exception as e:
+            reason = (str(e) if isinstance(e, _RollbackSignal)
+                      else f"{type(e).__name__}: {e}")
+            logger.warning("rolling %s back mid-rollout (%d/%d "
+                           "replicas were on %s): %s", rolled and
+                           ", ".join(rolled) or "nothing", len(rolled),
+                           len(fleet), wm.version, reason)
+            rolled_back = []
+            for name in reversed(rolled):
+                # force finish-on-(new): no survivor serves wm.version
+                # once the canary retires, so nothing may migrate out
+                self._install(name, old, force_finish=True)
+                rolled_back.append(name)
+                self._m_rolled.inc()
+            trace.instant("publish rollback", cat="deploy",
+                          version=wm.version, restored=old.version,
+                          replicas=len(rolled_back))
+            return PublishReport(
+                "rolled_back", wm.version, wm.neval, canary=verdict,
+                rolled=rolled, rolled_back=rolled_back,
+                duration_s=time.monotonic() - t0, error=reason)
+        # the fleet is 100% on the new version: future spin-ups
+        # (autoscaler add_replica) must build with it too
+        self.pool.set_default_model(wm.model, weight_version=wm.version)
+        self.current = wm
+        self._g_neval.set(wm.neval)
+        return PublishReport("ok", wm.version, wm.neval, canary=verdict,
+                             rolled=rolled,
+                             duration_s=time.monotonic() - t0)
+
+    def _install(self, name, wm, *, force_finish: bool = False) -> None:
+        """Drain -> swap -> resume for one replica. The drain policy
+        only ever says "migrate" while a survivor still serves the
+        draining replica's CURRENT version (the exported snapshot can
+        only be adopted there)."""
+        cfg = self.config
+        rep = self.pool[name]
+        draining_version = rep.weight_version
+        survivors = [n for n in self.pool.names
+                     if n != name and n != cfg.canary_name
+                     and self.pool[n].weight_version == draining_version]
+        policy = cfg.migrate_policy
+        if force_finish or policy is None or not survivors:
+            def policy(_rid):
+                return "finish"
+        self.router.drain(name, policy=policy,
+                          timeout=cfg.drain_timeout_s)
+        try:
+            rep.set_weights(wm.model, weight_version=wm.version)
+        finally:
+            # a failed swap leaves the OLD weights in place — resume
+            # unconditionally so the replica never parks in DRAINING
+            # (zero downtime even when the install itself errors)
+            self.router.resume(name)
+
+    def _slo_breach(self, rolled, baseline) -> str | None:
+        """Rollout-window SLO check: p99s of the histogram mass
+        observed SINCE the rollout began, across the rolled replicas,
+        vs the configured targets. None = healthy (or no watch/no
+        observations yet)."""
+        cfg = self.config
+        if cfg.slo is None:
+            return None
+        for name in rolled:
+            if name not in baseline:
+                continue
+            rep = self.pool[name]
+            ttft = percentile(_delta_snapshot(
+                rep.histogram_snapshot("serving_ttft_seconds"),
+                baseline[name][0]), 0.99)
+            dec = percentile(_delta_snapshot(
+                rep.histogram_snapshot("serving_decode_token_seconds"),
+                baseline[name][1]), 0.99)
+            if ttft is not None and ttft > cfg.slo.ttft_p99_s:
+                return (f"replica {name} ttft p99 {ttft:.4f}s > "
+                        f"{cfg.slo.ttft_p99_s}s during rollout")
+            if dec is not None and dec > cfg.slo.decode_token_p99_s:
+                return (f"replica {name} decode-token p99 {dec:.4f}s "
+                        f"> {cfg.slo.decode_token_p99_s}s during "
+                        "rollout")
+        return None
+
+    # -- telemetry --
+    def _record(self, action: str, **fields) -> None:
+        if self._recorder is None:
+            return
+        try:
+            self._recorder.record("publish", action, **fields)
+        except Exception:
+            logger.exception("flight-recorder publish event failed")

@@ -1,0 +1,121 @@
+"""Versioned weight sets: what the publisher loads, qualifies, rolls.
+
+One :class:`WeightManifest` is one READY-TO-SERVE weight set — a host
+module loaded from a manifest-committed elastic checkpoint
+(``bigdl_tpu/elastic/``), stamped with the version string that tags
+every replica serving it and every KV snapshot exported under it (the
+``weight_version`` plumbed through ``ContinuousBatcher`` /
+``KVSnapshot`` / the router). The version is derived from the
+checkpoint's ``neval`` — monotone by construction, because the trainer
+only ever commits forward.
+
+``quantize=True`` is the int8-at-rest conversion
+(``serving/quantized.py``): the candidate params pass through
+``quantize_params`` -> ``dequantize_params`` once, so the fleet serves
+exactly the weights an int8 artifact would reconstruct — parity between
+the canary and the rolled fleet is then parity of ONE weight tree, not
+of two quantization passes. ``quantize_params``'s idempotence guard
+keeps a second accidental conversion loud.
+
+HOST-ONLY CONTRACT (jaxlint JX5): no module-level jax import — the
+publisher thread must construct in supervisors that never initialize a
+device runtime; jax enters only via the lazy checkpoint/quantize calls.
+"""
+from __future__ import annotations
+
+__all__ = ["WeightManifest", "load_weight_version",
+           "write_model_checkpoint", "version_string"]
+
+
+def version_string(neval: int) -> str:
+    """The canonical version tag for a checkpoint: ``v<neval>``."""
+    return f"v{int(neval)}"
+
+
+class WeightManifest:
+    """One versioned, ready-to-serve weight set (see module
+    docstring). ``model`` is the live host module every replica of this
+    version shares read-only; ``manifest`` is the checkpoint manifest
+    it was committed under (None for a fleet's synthetic baseline
+    version)."""
+
+    __slots__ = ("version", "neval", "epoch", "source", "model",
+                 "quantized", "manifest")
+
+    def __init__(self, version: str, model, *, neval: int = -1,
+                 epoch: int = 0, source: str | None = None,
+                 quantized: bool = False, manifest: dict | None = None):
+        self.version = str(version)
+        self.model = model
+        self.neval = int(neval)
+        self.epoch = int(epoch)
+        self.source = source
+        self.quantized = bool(quantized)
+        self.manifest = manifest
+
+    def param_bytes(self) -> int:
+        """Total bytes of the served parameter leaves."""
+        import jax
+        return sum(int(getattr(l, "nbytes", 0))
+                   for l in jax.tree_util.tree_leaves(self.model.params))
+
+    def __repr__(self):
+        return (f"WeightManifest({self.version!r}, neval={self.neval}, "
+                f"quantized={self.quantized}, source={self.source!r})")
+
+
+def load_weight_version(path: str, *, neval: int | None = None,
+                        quantize: bool = False) -> WeightManifest:
+    """Load one committed checkpoint into a :class:`WeightManifest`.
+
+    ``neval=None`` takes the newest manifest under ``path``
+    (:func:`~bigdl_tpu.elastic.latest_checkpoint` — only COMPLETE
+    snapshots are ever eligible; the manifest is the commit point). The
+    module is switched to evaluate mode (serving never wants dropout)
+    and, with ``quantize=True``, its params are round-tripped through
+    the int8 codec so the fleet serves the int8-at-rest
+    reconstruction."""
+    from bigdl_tpu.elastic import load_checkpoint
+    model, _state, man = load_checkpoint(path, neval=neval)
+    model.evaluate()
+    quantized = False
+    if quantize:
+        from bigdl_tpu.serving.quantized import (dequantize_params,
+                                                 quantize_params)
+        model.params = dequantize_params(quantize_params(model.params))
+        model.sync(model.params, model.state)
+        quantized = True
+    return WeightManifest(version_string(man["neval"]), model,
+                          neval=int(man["neval"]),
+                          epoch=int(man.get("epoch", 0)),
+                          source=str(path), quantized=quantized,
+                          manifest=man)
+
+
+def write_model_checkpoint(path: str, model, *, neval: int,
+                           epoch: int = 0) -> str:
+    """Commit a model-only checkpoint in the elastic three-file format
+    (``model.N`` + ``state.N`` + ``manifest.N.json``, manifest LAST) —
+    what a trainer's ``set_checkpoint`` produces, minus optimizer
+    state. The publisher's drills and an offline conversion pipeline
+    (e.g. a quantized export) publish through this. Returns the
+    manifest path."""
+    import os
+
+    from bigdl_tpu.elastic import (build_manifest, manifest_name,
+                                   write_manifest)
+    from bigdl_tpu.elastic.checkpoint_writer import snapshot_to_host
+    from bigdl_tpu.utils import file as _file
+    _file.ensure_writable_dir(path)
+    suffix = f".{int(neval)}"
+    model_file, state_file = f"model{suffix}", f"state{suffix}"
+    _file.save_module(model, os.path.join(path, model_file),
+                      overwrite=True)
+    _file.save({"neval": int(neval), "epoch": int(epoch)},
+               os.path.join(path, state_file), overwrite=True)
+    man = build_manifest(neval=int(neval), epoch=int(epoch),
+                         model_file=model_file, state_file=state_file,
+                         params=snapshot_to_host(model.params))
+    man_path = os.path.join(path, manifest_name(suffix))
+    write_manifest(man, man_path)
+    return man_path

@@ -155,24 +155,56 @@ def _list_manifest_names(path: str) -> list[str]:
     return sorted(n for n in names if _MANIFEST_RE.match(n))
 
 
-def latest_checkpoint(path: str) -> dict | None:
+def latest_checkpoint(path: str, *, cache: dict | None = None) \
+        -> dict | None:
     """The newest COMPLETE checkpoint under ``path``: scan manifests,
     skip unreadable/torn ones with a warning, return the highest-neval
     manifest (or None when the directory holds no complete snapshot —
     a fresh start, not an error: the elastic runner's first attempt
-    and a post-crash resume share this call."""
+    and a post-crash resume share this call.
+
+    ``cache`` is the polling fast path: pass the SAME caller-owned dict
+    on every call (the weight publisher polls every few seconds) and a
+    manifest is re-read/re-parsed only when its mtime+size changed —
+    the atomic-rename commit always bumps both, and a torn/unreadable
+    verdict is re-tested on change too. Entries for deleted manifests
+    are dropped. Local filesystems only; URL paths always re-read."""
     best = None
+    seen = set()
     for name in _list_manifest_names(path):
-        full = f"{path}/{name}" if "://" in str(path) \
-            else os.path.join(path, name)
+        is_url = "://" in str(path)
+        full = f"{path}/{name}" if is_url else os.path.join(path, name)
+        seen.add(name)
+        sig = None
+        if cache is not None and not is_url:
+            try:
+                st = os.stat(full)
+                sig = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                sig = None
+            if sig is not None:
+                hit = cache.get(name)
+                if hit is not None and hit[0] == sig:
+                    man = hit[1]          # parsed (or None: torn)
+                    if man is not None and (
+                            best is None
+                            or int(man["neval"]) > int(best["neval"])):
+                        best = man
+                    continue
         try:
             man = read_manifest(full)
         except Exception as e:
             logger.warning("skipping unreadable checkpoint manifest "
                            "%s: %s", full, e)
-            continue
-        if best is None or int(man["neval"]) > int(best["neval"]):
+            man = None
+        if cache is not None and sig is not None:
+            cache[name] = (sig, man)
+        if man is not None and (best is None
+                                or int(man["neval"]) > int(best["neval"])):
             best = man
+    if cache is not None:
+        for stale in set(cache) - seen:
+            del cache[stale]
     return best
 
 

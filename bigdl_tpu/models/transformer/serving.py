@@ -1148,19 +1148,27 @@ class KVSnapshot:
     greedy decode then continues bitwise identically to the exporting
     batcher, because the continuation is a pure function of
     (params, KV state, last token) (test-pinned in
-    tests/test_serving_router.py)."""
+    tests/test_serving_router.py).
+
+    ``weight_version`` stamps WHICH params the KV was computed under
+    (the deploy plane's rolling weight publishes,
+    ``bigdl_tpu/deploy/``): adoption validates it against the target
+    batcher's version, because continuing a sequence under different
+    weights would silently mix versions mid-answer. ``None`` means
+    unversioned (a fleet that never published) and matches anything."""
 
     __slots__ = ("prompt", "n_cached", "kv", "last_token", "emitted",
-                 "page_size")
+                 "page_size", "weight_version")
 
     def __init__(self, prompt, n_cached, kv, last_token, emitted,
-                 page_size):
+                 page_size, weight_version=None):
         self.prompt = list(prompt)
         self.n_cached = int(n_cached)
         self.kv = kv
         self.last_token = int(last_token)
         self.emitted = list(emitted)
         self.page_size = int(page_size)
+        self.weight_version = weight_version
 
     @property
     def n_pages(self) -> int:
@@ -1173,7 +1181,8 @@ class KVSnapshot:
     def __repr__(self):
         return (f"KVSnapshot(prompt_len={len(self.prompt)}, "
                 f"n_cached={self.n_cached}, n_pages={self.n_pages}, "
-                f"emitted={len(self.emitted)})")
+                f"emitted={len(self.emitted)}, "
+                f"weight_version={self.weight_version!r})")
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -1238,9 +1247,13 @@ class ContinuousBatcher:
                  registry=None, summary=None, health=None,
                  watch=None, health_name: str = "serving_batcher",
                  on_complete=None, on_prefill=None, paged_kernel=None,
-                 aot_cache=None):
+                 aot_cache=None, weight_version=None):
         meta = model.lm_meta
         self.model = model
+        # which published weight set this batcher serves (deploy plane;
+        # None = unversioned). Exported KVSnapshots carry it and
+        # adoption validates it — see _validate_snapshot/set_weights.
+        self.weight_version = weight_version
         self.max_batch = max_batch
         self.max_new = max_new_tokens
         self.max_burst = max_burst
@@ -1388,6 +1401,17 @@ class ContinuousBatcher:
         return ids
 
     def _validate_snapshot(self, snap: KVSnapshot) -> None:
+        snap_version = getattr(snap, "weight_version", None)
+        if (snap_version is not None and self.weight_version is not None
+                and snap_version != self.weight_version):
+            # a version-mismatched snapshot is never adopted silently:
+            # the KV was computed under different params, so continuing
+            # it here would mix weight versions inside one answer
+            raise ValueError(
+                f"snapshot weight_version {snap_version!r} != batcher "
+                f"weight_version {self.weight_version!r} — finish the "
+                "request on an old-version replica or resubmit its "
+                "prompt fresh (docs/DEPLOYMENT.md, version skew)")
         if snap.page_size != self.page_size:
             raise ValueError(f"snapshot page_size {snap.page_size} != "
                              f"batcher page_size {self.page_size}")
@@ -1410,6 +1434,32 @@ class ContinuousBatcher:
             raise ValueError(
                 f"snapshot n_cached {snap.n_cached} exceeds its "
                 f"{snap.n_pages} pages x {self.page_size} slots")
+
+    def set_weights(self, model, weight_version) -> None:
+        """Swap the served weights in place (the deploy plane's reload
+        step after a drain, ``bigdl_tpu/deploy/``). Only legal while
+        idle: an in-flight sequence's KV was computed under the OLD
+        params, and decoding it further under new ones would silently
+        mix versions — the router drains first (finish-on-old or
+        migrate), then swaps, then resumes. Geometry must match the
+        construction model: the compiled prefill/decode executables key
+        on abstract shapes with params as runtime arguments, so a
+        same-geometry swap re-uses every executable and compiles
+        nothing."""
+        if not self.idle:
+            raise RuntimeError(
+                f"cannot swap weights with {len(self.queue)} queued and "
+                f"{sum(s is not None for s in self.slots)} in-flight "
+                "requests — drain the replica first")
+        new, old = model.lm_meta, self.model.lm_meta
+        keys = ("num_layers", "num_heads", "num_kv_heads", "max_len")
+        if any(new.get(k) != old.get(k) for k in keys):
+            raise ValueError(
+                "set_weights requires identical model geometry: "
+                + "; ".join(f"{k}: {old.get(k)} -> {new.get(k)}"
+                            for k in keys if new.get(k) != old.get(k)))
+        self.model = model
+        self.weight_version = weight_version
 
     def submit(self, request_id, prompt=None, *,
                snapshot: KVSnapshot | None = None) -> None:
@@ -1589,7 +1639,8 @@ class ContinuousBatcher:
             kv = self._export_kv(self._pages[slot], n_cached)
         self._m_export.inc()
         return KVSnapshot(prompt, n_cached, kv, int(self.last[slot]),
-                          got, self.page_size)
+                          got, self.page_size,
+                          weight_version=self.weight_version)
 
     def export_request(self, request_id) -> KVSnapshot:
         """Export one IN-FLIGHT request for handoff: gathers its KV
@@ -1658,7 +1709,8 @@ class ContinuousBatcher:
         finally:
             self.cache.free(pages)
         return KVSnapshot(prompt, len(prompt), kv, tok0, [tok0],
-                          self.page_size)
+                          self.page_size,
+                          weight_version=self.weight_version)
 
     def _release(self, slot: int) -> None:
         """Free a slot's pages and reset its row — no result

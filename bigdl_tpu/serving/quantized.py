@@ -61,13 +61,26 @@ def quantize_params(params, *, min_ndim: int = 2):
     """f32 parameter leaves with ``ndim >= min_ndim`` ->
     ``{"q": int8, "s": scale}`` (codec: symmetric last-axis
     ``int8_quantize``). Smaller/integer leaves pass through untouched;
-    :func:`dequantize_params` inverts the structure."""
+    :func:`dequantize_params` inverts the structure.
+
+    Idempotence guard: a tree that ALREADY holds quantized leaves is
+    rejected loudly. Re-quantizing would treat the int8 codes as
+    floats and corrupt the weights silently — an easy foot-gun on the
+    weight publisher's reload path, where a checkpoint may have been
+    converted once already."""
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.parameters.compression import int8_quantize
 
     def one(leaf):
+        if is_quantized_leaf(leaf):
+            raise ValueError(
+                "params are already int8-quantized (found a "
+                "{'q', 's'} leaf) — quantizing twice would re-encode "
+                "the int8 codes as floats and silently corrupt the "
+                "weights; dequantize_params first if a re-quantize is "
+                "really intended")
         x = jnp.asarray(leaf)
         if x.ndim < min_ndim or not jnp.issubdtype(x.dtype,
                                                    jnp.floating):
@@ -75,7 +88,8 @@ def quantize_params(params, *, min_ndim: int = 2):
         q, s = int8_quantize(x.astype(jnp.float32))
         return {"q": q, "s": s}
 
-    return jax.tree_util.tree_map(one, params)
+    return jax.tree_util.tree_map(one, params,
+                                  is_leaf=is_quantized_leaf)
 
 
 def dequantize_params(qparams):

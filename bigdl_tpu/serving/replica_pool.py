@@ -147,6 +147,26 @@ class Replica:
         ok, detail = self.batcher._ready()
         return ok, f"{self.name}: {detail}"
 
+    # -- weight version (deploy plane; bigdl_tpu/deploy/) --
+    @property
+    def weight_version(self):
+        """Which published weight set this replica serves (None =
+        unversioned). Lives on the batcher so exported KV snapshots
+        carry it."""
+        return getattr(self.batcher, "weight_version", None)
+
+    def set_weights(self, model=None, *, weight_version) -> None:
+        """Swap the served weights (``model=None`` just re-stamps the
+        version — the publisher uses that to mark a pre-existing fleet
+        as version v0). The batcher enforces idleness and identical
+        geometry; callers drain first (``Router.drain``) and ``resume``
+        after."""
+        with self.lock:
+            if model is None:
+                self.batcher.weight_version = weight_version
+            else:
+                self.batcher.set_weights(model, weight_version)
+
     # -- request plane (router-facing; all under the replica lock) --
     def submit(self, request_id, prompt=None, *, snapshot=None) -> None:
         with self.lock:
@@ -171,6 +191,18 @@ class Replica:
     def export_requests(self) -> list:
         with self.lock:
             return self.batcher.export_requests()
+
+    def export_request(self, request_id):
+        """Export ONE in-flight request's KV snapshot (frees its slot).
+        The router's per-request drain policy uses this to migrate a
+        chosen subset while the rest finish here."""
+        with self.lock:
+            return self.batcher.export_request(request_id)
+
+    def inflight_ids(self) -> list:
+        """Ids currently occupying slots (not the queue)."""
+        with self.lock:
+            return [s[0] for s in self.batcher.slots if s is not None]
 
     def pop_queued(self) -> list:
         with self.lock:
@@ -227,7 +259,7 @@ class ReplicaPool:
 
     def __init__(self, model, n_replicas: int = 2, *, names=None,
                  burst=None, health=None, start: bool = True,
-                 aot_cache=None, **batcher_kwargs):
+                 aot_cache=None, weight_version=None, **batcher_kwargs):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         if names is None:
@@ -237,6 +269,7 @@ class ReplicaPool:
                              f"{names}")
         self._health = health if health is not None else default_health()
         self._model = model
+        self._weight_version = weight_version
         self._burst = burst
         self._batcher_kwargs = dict(batcher_kwargs)
         # ONE shared AOT pipeline for every replica this pool ever
@@ -261,22 +294,44 @@ class ReplicaPool:
         if start:
             self.start()
 
-    def _build_replica(self, name: str) -> Replica:
+    def _build_replica(self, name: str, *, model=None,
+                       weight_version=None) -> Replica:
         # lazy: keeps this module importable without jax (JX5 contract)
         from bigdl_tpu.models.transformer.serving import ContinuousBatcher
         reg = MetricRegistry()
         batcher = ContinuousBatcher(
-            self._model, registry=reg, health=self._health,
+            model if model is not None else self._model,
+            registry=reg, health=self._health,
             health_name=f"serving_batcher_{name}",
             **self._batcher_kwargs)
+        # stamped post-construction so monkeypatched batcher fakes that
+        # predate the kwarg keep working
+        batcher.weight_version = (weight_version
+                                  if weight_version is not None
+                                  else self._weight_version)
         rep = Replica(name, batcher, registry=reg, burst=self._burst,
                       health=self._health)
         self.replicas[name] = rep
         return rep
 
+    @property
+    def model(self):
+        """The default model newly built replicas serve."""
+        return self._model
+
+    def set_default_model(self, model, *, weight_version=None) -> None:
+        """Point FUTURE replica builds (``add_replica`` — autoscaler
+        spin-ups included) at a new weight set. Does not touch running
+        replicas; the publisher rolls those one by one
+        (``Replica.set_weights``) and then calls this so scale-ups
+        never resurrect the old version."""
+        self._model = model
+        self._weight_version = weight_version
+
     # -- elastic membership (the autoscaler's primitives) --
     def add_replica(self, name: str | None = None, *, start: bool = True,
-                    warm: bool = True) -> Replica:
+                    warm: bool = True, model=None,
+                    weight_version=None) -> Replica:
         """Build one more identically configured replica and (with the
         pool running) put it in rotation. With the pool's shared AOT
         pipeline the new batcher compiles nothing — its executables
@@ -286,7 +341,10 @@ class ReplicaPool:
         construction. Auto-names ``rN`` when ``name`` is omitted.
         Registers the replica's two health checks as a side effect of
         construction. Callers fronting the pool with a Router must also
-        ``router.attach_replica(name)`` to wire completion hooks."""
+        ``router.attach_replica(name)`` to wire completion hooks.
+        ``model``/``weight_version`` override the pool defaults — the
+        weight publisher's canary spins up on the CANDIDATE weights
+        while the fleet keeps serving the current ones."""
         if name is None:
             while f"r{self._next_auto}" in self.replicas:
                 self._next_auto += 1
@@ -294,7 +352,8 @@ class ReplicaPool:
             self._next_auto += 1
         if name in self.replicas:
             raise ValueError(f"replica {name!r} already exists")
-        rep = self._build_replica(name)
+        rep = self._build_replica(name, model=model,
+                                  weight_version=weight_version)
         if warm:
             rep.batcher.warmup()
         if start and self._running:

@@ -108,6 +108,10 @@ class Router:
         self._m_migrated = reg.counter(
             "router_migrations_total",
             "in-flight requests moved between replicas during drain")
+        self._m_restarts = reg.counter(
+            "router_version_restarts_total",
+            "orphaned KV snapshots (weight version no longer served "
+            "anywhere) restarted from their prompt on the current fleet")
         self._m_pending = reg.gauge(
             "router_pending_depth",
             "requests waiting at the router for an admissible replica")
@@ -133,6 +137,21 @@ class Router:
         self._sessions: dict = {}       # session id -> replica name
         self._closed = False
 
+        # replicas the router must NOT place live traffic on even
+        # though they sit in the pool: the weight publisher's canary
+        # qualifies on candidate weights and is driven directly, never
+        # through live routing. Quarantine a name BEFORE add_replica
+        # and there is no window where the dispatcher can see it.
+        self._quarantined: set = set()
+
+        # observer taps (assignable; both optional, crash-fenced): the
+        # deploy plane's ShadowTap mirrors a fraction of live traffic
+        # onto a canary replica through these without sitting in the
+        # request path. on_submit(rid, prompt) fires once per ACCEPTED
+        # prompt; on_result(rid, tokens) once per completion.
+        self.on_submit = None
+        self.on_result = None
+
         for name, rep in pool.replicas.items():
             rep.batcher.on_complete = self._make_on_complete(name)
             if self._capture:
@@ -150,6 +169,14 @@ class Router:
                 self._inflight.pop(rid, None)
                 self._results.append((rid, list(toks)))
             self._m_completed.inc()
+            tap = self.on_result
+            if tap is not None:
+                try:
+                    tap(rid, list(toks))
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "on_result tap failed for %r", rid)
             self._pump_wake.set()
         return hook
 
@@ -167,10 +194,21 @@ class Router:
         n_ok = 0
         for rep in self.pool:
             # racy read by design: probes must not block on locks
-            if rep.state == "active" and rep.batcher._ready()[0]:
+            if (rep.state == "active" and rep.name not in
+                    self._quarantined and rep.batcher._ready()[0]):
                 n_ok += 1
         return (n_ok > 0,
                 f"{n_ok}/{len(self.pool)} replicas admitting")
+
+    # -- quarantine (the publisher's canary fence) --
+    def quarantine(self, name: str) -> None:
+        """Exclude ``name`` from live placement (see the field comment
+        in ``__init__``). Safe to call before the replica exists."""
+        self._quarantined.add(name)
+
+    def unquarantine(self, name: str) -> None:
+        self._quarantined.discard(name)
+        self._pump_wake.set()
 
     # -- submission --
     def submit(self, request_id, prompt, *, session=None):
@@ -206,6 +244,14 @@ class Router:
                         f"(slo.max_pending={self.slo.max_pending})")
                 self._pending.append((request_id, prompt, session))
                 self._m_pending.set(len(self._pending))
+        tap = self.on_submit
+        if tap is not None:
+            try:
+                tap(request_id, list(prompt))
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "on_submit tap failed for %r", request_id)
         return placed
 
     def cancel(self, request_id) -> bool:
@@ -230,11 +276,26 @@ class Router:
     def _fleet_stats(self) -> dict:
         stats = {}
         for rep in self.pool:
+            if rep.name in self._quarantined:
+                continue
             s = rep.stats()
             stats[s.name] = s
             self._m_rq.set(s.queue_depth, replica=s.name)
             self._m_rutil.set(s.kv_utilization, replica=s.name)
         return stats
+
+    def _version_of(self, name):
+        rep = self.pool.replicas.get(name)
+        return getattr(rep, "weight_version", None) if rep else None
+
+    def _version_ok(self, snapshot, name) -> bool:
+        """May ``name`` adopt ``snapshot``? None on either side means
+        unversioned and matches anything (mirrors the batcher's own
+        adopt-time check — the router filters up front so a mismatch
+        never even reaches a replica)."""
+        sv = getattr(snapshot, "weight_version", None)
+        rv = self._version_of(name)
+        return sv is None or rv is None or sv == rv
 
     def _dispatch(self, rid, payload, session):
         """Try to place ``payload`` (a prompt list, or a KVSnapshot
@@ -242,9 +303,27 @@ class Router:
         name or None when nothing admits right now."""
         # prompts arrive as lists; anything else is a KV snapshot
         is_prompt = isinstance(payload, list)
+        if not is_prompt and not any(self._version_ok(payload, n)
+                                     for n in self.pool.names):
+            # the snapshot's weight version is no longer served by ANY
+            # pool member (a rolling publish retired it while this sat
+            # in pending): its KV can never be adopted again, so
+            # restart the sequence from its prompt — the result is then
+            # attributable to exactly ONE (the current) version, and
+            # the request still completes exactly once
+            self._m_restarts.inc()
+            payload = list(payload.prompt)
+            is_prompt = True
         stats = self._fleet_stats()
         cands = [s for s in stats.values()
                  if admissible(s, self.slo)[0]]
+        if not is_prompt:
+            # a snapshot's KV is only valid under the params that wrote
+            # it: place it on a version-matching replica or keep it
+            # parked (during a rolling publish the old-version
+            # survivors are exactly that set)
+            cands = [s for s in cands
+                     if self._version_ok(payload, s.name)]
         with trace.span("route", cat="serving",
                         prompt_len=len(payload) if is_prompt else
                         len(payload.prompt),
@@ -252,13 +331,22 @@ class Router:
             if is_prompt:
                 hit = self.prefix.lookup(payload)
                 if hit is not None and cands:
-                    target = (hit.replica
-                              if hit.replica in {s.name for s in cands}
-                              else min(cands, key=load_score).name)
-                    self.pool[target].submit(rid, snapshot=hit.snapshot)
-                    self._m_prefix_hits.inc()
-                    self._place(rid, target, session)
-                    return target
+                    vcands = [s for s in cands
+                              if self._version_ok(hit.snapshot, s.name)]
+                    if vcands:
+                        target = (hit.replica
+                                  if hit.replica in {s.name
+                                                     for s in vcands}
+                                  else min(vcands,
+                                           key=load_score).name)
+                        self.pool[target].submit(rid,
+                                                 snapshot=hit.snapshot)
+                        self._m_prefix_hits.inc()
+                        self._place(rid, target, session)
+                        return target
+                    # retained prefix from a superseded weight version:
+                    # fall through to a fresh prefill (the rollout's
+                    # drains forget stale entries replica by replica)
                 if (len(payload) >= self.slo.long_prefill_tokens
                         and len(cands) > 1):
                     return self._dispatch_disaggregated(
@@ -383,23 +471,40 @@ class Router:
 
     # -- drain / rolling restart --
     def drain(self, name: str, *, migrate: bool = False,
-              timeout: float = 120.0) -> dict:
+              timeout: float = 120.0, policy=None) -> dict:
         """Take replica ``name`` out of rotation: admissions stop and
         its ``serving_replica_<name>`` readiness flips immediately;
         still-queued requests re-dispatch to the survivors; in-flight
         sequences either finish here (default) or — ``migrate=True`` —
         export their KV mid-decode and resume on other replicas,
-        bitwise. Returns a summary dict. ``resume(name)`` puts the
-        replica back."""
+        bitwise. ``policy`` decides per request instead:
+        ``policy(request_id) -> "finish" | "migrate"`` — the weight
+        publisher's version-skew knob (migrated snapshots carry the OLD
+        weight version and only ever land on old-version survivors;
+        with none left they would park, so the publisher forces
+        "finish" for the last replica of a version). Returns a summary
+        dict. ``resume(name)`` puts the replica back."""
         rep = self.pool[name]
         with trace.span("drain", cat="serving", replica=name,
-                        migrate=migrate):
+                        migrate=migrate, policy=policy is not None):
             rep.drain_begin()
             requeued = rep.pop_queued()
             for rid, payload in requeued:
                 self._requeue(rid, payload)
             migrated = []
-            if migrate:
+            if policy is not None:
+                for rid in rep.inflight_ids():
+                    if policy(rid) != "migrate":
+                        continue
+                    snap = rep.export_request(rid)
+                    migrated.append((rid, snap))
+                    self._m_migrated.inc()
+                    self._requeue(rid, snap)
+                if not rep.wait_idle(timeout):
+                    raise TimeoutError(
+                        f"replica {name} did not finish its kept "
+                        f"in-flight requests in {timeout}s")
+            elif migrate:
                 migrated = rep.export_requests()
                 for rid, snap in migrated:
                     self._m_migrated.inc()
@@ -444,10 +549,10 @@ class Router:
         merged by bucket (conservative upper-bound estimates)."""
         ttft = merge_snapshots(
             r.histogram_snapshot("serving_ttft_seconds")
-            for r in self.pool)
+            for r in self.pool if r.name not in self._quarantined)
         dec = merge_snapshots(
             r.histogram_snapshot("serving_decode_token_seconds")
-            for r in self.pool)
+            for r in self.pool if r.name not in self._quarantined)
         return {
             "ttft_p50_s": percentile(ttft, 0.5),
             "ttft_p99_s": percentile(ttft, 0.99),

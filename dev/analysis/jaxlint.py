@@ -72,11 +72,16 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # elastic/: manifests, the checkpoint writer thread, and the restart
 # runner are host machinery (the runner must not even initialize a
 # backend); snapshot/placement calls lazy-import jax where issued
+# deploy/: the weight publisher / canary control plane is host
+# orchestration over the replica API — checkpoint loading and the
+# quantize round-trip lazy-import jax inside the functions that issue
+# them
 HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
                       "bigdl_tpu/dataset/prefetch.py",
                       "bigdl_tpu/serving/",
                       "bigdl_tpu/tuning/",
-                      "bigdl_tpu/elastic/")
+                      "bigdl_tpu/elastic/",
+                      "bigdl_tpu/deploy/")
 
 # the per-iteration-sync flavor of JX1 only applies to library code:
 # tests and dev tooling are host drivers that sync deliberately

@@ -37,6 +37,7 @@ MODULES = {
     "serving": ["tests/test_serving_router.py",
                 "tests/test_autoscaler.py",
                 "tests/test_quantized_serving.py"],
+    "deploy": ["tests/test_deploy.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],

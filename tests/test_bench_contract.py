@@ -1162,3 +1162,60 @@ class TestAutoscaleRow:
         assert row["cold_aot_misses"] >= 1
         assert row["conserved"] is True
         assert 0 < row["value"] <= row["cold_time_to_capacity_s"] * 5
+
+
+class TestPublishRow:
+    """ISSUE 16: publish_to_fleet_secs — committed checkpoint -> 100%
+    of the fleet serving it (warm canary, zero compiles, zero
+    dropped/duplicated requests) — rides the standard row/known/all
+    contract. Lower is better and the gate knows."""
+
+    FAKE = {"metric": "publish_to_fleet_secs", "value": 0.42,
+            "unit": "seconds committed checkpoint -> 100% of fleet "
+                    "(2 replicas, warm canary)",
+            "canary_compiles": 0, "replicas_rolled": 2,
+            "rollback_drill_outcome": "canary_failed",
+            "rollback_kept_fleet": True, "fleet_version": "v2",
+            "n_requests": 12, "conserved": True}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_publish_to_fleet",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "publish_to_fleet_secs",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "publish_to_fleet_secs"
+        assert lines[-1]["rows"][0]["value"] == 0.42
+        with open(out) as f:
+            assert "bench_publish_to_fleet_secs 0.42" in f.read()
+
+    def test_row_in_all_and_gate_direction(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "publish_to_fleet_secs" in \
+            [r["metric"] for r in agg["rows"]]
+        # a slower commit-to-fleet rollout is the regression
+        assert "publish_to_fleet_secs" in bench._GATE_LOWER_IS_BETTER
+
+    @pytest.mark.slow
+    def test_real_probe_rolls_and_rolls_back(self):
+        """The REAL drill (tiny geometry): the publish must roll both
+        replicas with a zero-compile warm canary and conserve every
+        request; the parity-failing follow-up commit must leave the
+        fleet on the published version."""
+        row = bench.bench_publish_to_fleet(n_requests=9)
+        assert row["metric"] == "publish_to_fleet_secs"
+        assert row["value"] > 0
+        assert row["canary_compiles"] == 0
+        assert row["replicas_rolled"] == 2
+        assert row["conserved"] is True
+        assert row["fleet_version"] == "v2"
+        assert row["rollback_drill_outcome"] == "canary_failed"
+        assert row["rollback_kept_fleet"] is True

@@ -1,0 +1,726 @@
+"""Continuous-deployment contracts (bigdl_tpu/deploy/; ISSUE 16).
+
+The load-bearing invariants, all CPU-pinned on a tiny model:
+
+- version plumbing: every exported KV snapshot carries the publishing
+  ``weight_version``; a version-mismatched snapshot is NEVER adopted
+  silently, and a migrated request continues bitwise on an old-version
+  survivor (finish-on-old and migrate both pinned to one version);
+- a snapshot whose version no longer exists anywhere in the pool
+  restarts from its prompt on the current fleet — exactly once, and
+  the result is attributable to exactly one weight version;
+- the :class:`WeightPublisher` rolls a 2-replica fleet checkpoint ->
+  warm canary (zero compiles off the shared AOT cache) -> drain ->
+  reload -> resume, with every request submitted before/during/after
+  the publish delivered exactly once;
+- a parity-failing canary rolls NOTHING (fleet stays 100% on the old
+  version, zero dropped requests), and a mid-rollout failure restores
+  every already-rolled replica — never a partial downgrade;
+- ``latest_checkpoint``'s mtime+size poll fast path re-parses only
+  changed manifests; ``quantize_params`` refuses already-quantized
+  trees loudly.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.deploy import (CanaryConfig, PublisherConfig, ShadowTap,
+                              WeightPublisher, load_weight_version,
+                              qualify, version_string,
+                              write_model_checkpoint)
+from bigdl_tpu.elastic import manifest as manifest_mod
+from bigdl_tpu.elastic.manifest import latest_checkpoint
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.models.transformer.generate import (GenerationConfig,
+                                                   generate)
+from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+from bigdl_tpu.observability.exporter import HealthRegistry
+from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.serving import (PrefixCache, ReplicaPool, Router,
+                               SLOConfig)
+from bigdl_tpu.serving.quantized import (dequantize_params,
+                                         quantize_params)
+
+V = 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                      max_len=64)
+    m.materialize(jax.random.PRNGKey(6))
+    m.evaluate()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model2():
+    """Same geometry, different weights — the 'new checkpoint'."""
+    m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                      max_len=64)
+    m.materialize(jax.random.PRNGKey(7))
+    m.evaluate()
+    return m
+
+
+def _prompts(lengths, seed=4):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(1, V + 1, size=(n,))) for n in lengths]
+
+
+def _greedy(model, prompt, n_new=6):
+    cfg = GenerationConfig(max_new_tokens=n_new, temperature=0.0)
+    return np.asarray(generate(model, np.asarray([prompt], np.int32),
+                               cfg))[0]
+
+
+GEO = dict(max_batch=2, num_pages=64, page_size=4, max_new_tokens=6,
+           max_burst=4)
+
+
+def _plane(model, *, slo=None, n=2, geo=None, weight_version=None,
+           aot_cache=None, **router_kw):
+    health = HealthRegistry()
+    reg = MetricRegistry()
+    geo = geo or GEO
+    pool = ReplicaPool(model, n, health=health,
+                       burst=min(4, geo["max_burst"]),
+                       weight_version=weight_version,
+                       aot_cache=aot_cache, **geo)
+    router = Router(pool, slo=slo or SLOConfig(long_prefill_tokens=32),
+                    prefix_cache=PrefixCache(min_tokens=4),
+                    registry=reg, health=health, **router_kw)
+    return health, reg, pool, router
+
+
+# ---------------------------------------------------------------------------
+# versioned checkpoints (deploy/version.py)
+
+class TestVersionedCheckpoints:
+    def test_version_string(self):
+        assert version_string(7) == "v7"
+
+    def test_write_load_roundtrip_and_latest_wins(self, model, model2,
+                                                  tmp_path):
+        d = str(tmp_path)
+        write_model_checkpoint(d, model, neval=3)
+        wm = load_weight_version(d)
+        assert (wm.version, wm.neval, wm.quantized) == ("v3", 3, False)
+        p = _prompts([6], seed=30)[0]
+        np.testing.assert_array_equal(_greedy(wm.model, p),
+                                      _greedy(model, p))
+        # a newer commit wins; neval= pins an older one
+        write_model_checkpoint(d, model2, neval=5)
+        assert load_weight_version(d).neval == 5
+        assert load_weight_version(d, neval=3).neval == 3
+
+    def test_quantize_loads_int8_at_rest_reconstruction(self, model2,
+                                                        tmp_path):
+        d = str(tmp_path)
+        write_model_checkpoint(d, model2, neval=4)
+        wm = load_weight_version(d, quantize=True)
+        assert wm.quantized
+        want = dequantize_params(quantize_params(model2.params))
+        got_leaf = wm.model.params["0"]["tok"]
+        np.testing.assert_allclose(np.asarray(got_leaf),
+                                   np.asarray(want["0"]["tok"]))
+
+
+class TestQuantizeIdempotenceGuard:
+    def test_double_quantize_is_loud(self, model):
+        q = quantize_params(model.params)
+        with pytest.raises(ValueError,
+                           match="already int8-quantized"):
+            quantize_params(q)
+        # the sanctioned path: dequantize first, then re-quantize
+        rq = quantize_params(dequantize_params(q))
+        np.testing.assert_array_equal(
+            np.asarray(rq["0"]["tok"]["q"]),
+            np.asarray(q["0"]["tok"]["q"]))
+
+
+# ---------------------------------------------------------------------------
+# satellite: latest_checkpoint mtime+size poll fast path
+
+class TestManifestPollFastPath:
+    @staticmethod
+    def _commit(d, neval):
+        suffix = f".{neval}"
+        man = manifest_mod.build_manifest(
+            neval=neval, epoch=0, model_file=f"model{suffix}",
+            state_file=f"state{suffix}", params=None)
+        manifest_mod.write_manifest(
+            man, os.path.join(d, manifest_mod.manifest_name(suffix)))
+
+    def test_unchanged_manifests_parse_zero_times(self, tmp_path,
+                                                  monkeypatch):
+        d = str(tmp_path)
+        calls = []
+        real = manifest_mod.read_manifest
+        monkeypatch.setattr(manifest_mod, "read_manifest",
+                            lambda p: (calls.append(p), real(p))[1])
+        self._commit(d, 1)
+        self._commit(d, 2)
+        cache = {}
+        assert latest_checkpoint(d, cache=cache)["neval"] == 2
+        assert len(calls) == 2            # cold scan parses everything
+        calls.clear()
+        assert latest_checkpoint(d, cache=cache)["neval"] == 2
+        assert calls == []                # fast path: zero re-parses
+        # a new commit parses exactly itself
+        self._commit(d, 3)
+        assert latest_checkpoint(d, cache=cache)["neval"] == 3
+        assert len(calls) == 1
+        # no-cache callers still re-read everything, every time
+        calls.clear()
+        latest_checkpoint(d)
+        assert len(calls) == 3
+
+    def test_changed_torn_and_deleted_entries(self, tmp_path,
+                                              monkeypatch):
+        d = str(tmp_path)
+        calls = []
+        real = manifest_mod.read_manifest
+        monkeypatch.setattr(manifest_mod, "read_manifest",
+                            lambda p: (calls.append(p), real(p))[1])
+        self._commit(d, 1)
+        cache = {}
+        assert latest_checkpoint(d, cache=cache)["neval"] == 1
+        # torn write (NOT atomic — simulates a crash mid-commit):
+        # skipped with a warning, and the verdict is cached too
+        torn = os.path.join(d, manifest_mod.manifest_name(".2"))
+        with open(torn, "w") as f:
+            f.write("{not json")
+        calls.clear()
+        assert latest_checkpoint(d, cache=cache)["neval"] == 1
+        assert len(calls) == 1            # parsed (and failed) once
+        calls.clear()
+        assert latest_checkpoint(d, cache=cache)["neval"] == 1
+        assert calls == []                # torn verdict cached
+        # the commit completes (content + mtime change): re-parsed
+        self._commit(d, 2)
+        assert latest_checkpoint(d, cache=cache)["neval"] == 2
+        # deletion evicts the cache entry
+        os.remove(torn)
+        assert latest_checkpoint(d, cache=cache)["neval"] == 1
+        assert manifest_mod.manifest_name(".2") not in cache
+
+    def test_mtime_bump_with_new_content_is_seen(self, tmp_path):
+        d = str(tmp_path)
+        self._commit(d, 1)
+        cache = {}
+        assert latest_checkpoint(d, cache=cache)["neval"] == 1
+        # same filename, new content (overwrite_checkpoint-style):
+        # the rename bumps mtime, so the cache must not serve neval=1
+        name = os.path.join(d, manifest_mod.manifest_name(".1"))
+        man = dict(json.loads(open(name).read()), neval=9)
+        manifest_mod.write_manifest(man, name)
+        os.utime(name, ns=(os.stat(name).st_mtime_ns + 10_000_000,) * 2)
+        assert latest_checkpoint(d, cache=cache)["neval"] == 9
+
+
+# ---------------------------------------------------------------------------
+# satellite: version skew — batcher-level plumbing
+
+class TestVersionPlumbing:
+    def _batcher(self, model, version, **over):
+        geo = dict(GEO, **over)
+        return ContinuousBatcher(model, registry=MetricRegistry(),
+                                 health=HealthRegistry(),
+                                 weight_version=version, **geo)
+
+    def test_snapshot_carries_version_and_mismatch_is_loud(
+            self, model, model2):
+        p = _prompts([6], seed=40)[0]
+        a = self._batcher(model, "v1")
+        a.submit("r", p)
+        a.step(burst=2)                       # admit + first burst
+        snap = a.export_request("r")
+        assert snap.weight_version == "v1"
+        # never adopted silently across versions
+        b = self._batcher(model2, "v2")
+        with pytest.raises(ValueError, match="weight_version"):
+            b.submit("r", snapshot=snap)
+        # same-version adoption continues bitwise
+        c = self._batcher(model, "v1")
+        c.submit("r", snapshot=snap)
+        res = dict(c.run_to_completion(burst=2))
+        np.testing.assert_array_equal(res["r"], _greedy(model, p))
+        # unversioned batchers interoperate (back-compat)
+        d = self._batcher(model, None)
+        d.submit("r", snapshot=snap)
+        res = dict(d.run_to_completion(burst=2))
+        np.testing.assert_array_equal(res["r"], _greedy(model, p))
+
+    def test_set_weights_requires_idle_and_same_geometry(
+            self, model, model2):
+        p = _prompts([5], seed=41)[0]
+        b = self._batcher(model, "v1")
+        b.submit("r", p)
+        b.step(burst=2)
+        with pytest.raises(RuntimeError, match="drain"):
+            b.set_weights(model2, "v2")
+        b.run_to_completion(burst=2)
+        small = TransformerLM(V, d_model=32, num_heads=4, num_layers=1,
+                              max_len=64)
+        small.materialize(jax.random.PRNGKey(8))
+        with pytest.raises(ValueError, match="geometry"):
+            b.set_weights(small, "v2")
+        b.set_weights(model2, "v2")
+        assert b.weight_version == "v2"
+        b.submit("r2", p)
+        res = dict(b.run_to_completion(burst=2))
+        np.testing.assert_array_equal(res["r2"], _greedy(model2, p))
+
+
+# ---------------------------------------------------------------------------
+# satellite: version skew — router-level exactly-once
+
+class TestVersionSkew:
+    def test_migrate_policy_pins_old_version_bitwise(self, model):
+        """drain(policy=migrate) mid-decode: the snapshot lands on an
+        OLD-version survivor and the result is bitwise the old-model
+        greedy continuation — attributable to exactly one version."""
+        geo = dict(GEO, max_new_tokens=12, max_burst=2)
+        health, reg, pool, router = _plane(model, geo=geo,
+                                           weight_version="v1")
+        try:
+            p = _prompts([10], seed=17)[0]
+            router.drain("r1", timeout=60)   # force placement on r0
+            r0 = pool["r0"]
+            with r0.lock:                    # freeze r0's driver
+                assert router.submit("mg", p) == "r0"
+                r0.batcher.step(burst=2)
+                slot = [s for s in r0.batcher.slots if s is not None]
+                assert slot and 1 <= len(slot[0][2]) < 12  # mid-decode
+                router.resume("r1")
+                router.drain("r0", policy=lambda rid: "migrate",
+                             timeout=60)
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            np.testing.assert_array_equal(res["mg"],
+                                          _greedy(model, p, 12))
+            assert reg.get("router_migrations_total").value() == 1
+            assert reg.get("router_version_restarts_total").value() == 0
+            assert pool["r1"].stats().prefill_skips >= 1
+        finally:
+            router.close()
+            pool.close()
+
+    def test_orphaned_snapshot_restarts_on_new_version(self, model,
+                                                       model2):
+        """A migrated snapshot whose version no longer exists ANYWHERE
+        is never adopted: the request restarts from its prompt on the
+        current fleet — exactly once, result == the NEW model's
+        greedy."""
+        geo = dict(GEO, max_new_tokens=12, max_burst=2)
+        health, reg, pool, router = _plane(model, geo=geo,
+                                           weight_version="v1")
+        try:
+            p = _prompts([10], seed=19)[0]
+            old, new = _greedy(model, p, 12), _greedy(model2, p, 12)
+            assert not np.array_equal(old, new)   # oracles distinguish
+            router.drain("r1", timeout=60)
+            r0 = pool["r0"]
+            with r0.lock:
+                assert router.submit("or", p) == "r0"
+                r0.batcher.step(burst=2)
+                snap = r0.export_request("or")    # freed: r0 now idle
+                assert snap.weight_version == "v1"
+                # the whole fleet moves to v2 before re-dispatch
+                r0.set_weights(model2, weight_version="v2")
+                pool["r1"].set_weights(model2, weight_version="v2")
+                router.resume("r1")
+                router._requeue("or", snap)
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == ["or"]          # exactly once
+            np.testing.assert_array_equal(res["or"], new)
+            assert reg.get("router_version_restarts_total").value() == 1
+        finally:
+            router.close()
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# the publisher end-to-end (fast: poll_once drives the loop body)
+
+class TestWeightPublisher:
+    def test_publish_rolls_fleet_exactly_once(self, model, model2,
+                                              tmp_path):
+        """ISSUE 16 acceptance, in-process: checkpoint N+1 lands while
+        the fleet serves -> warm canary qualifies with ZERO compiles ->
+        both replicas roll -> every request submitted before/during/
+        after is delivered exactly once, each attributable to exactly
+        one weight version, and post-publish traffic serves the new
+        weights."""
+        ck = str(tmp_path / "ck")
+        write_model_checkpoint(ck, model, neval=1)
+        health, reg, pool, router = _plane(
+            model, aot_cache=str(tmp_path / "aot"))
+        pub = None
+        try:
+            pin = _prompts([6], seed=50)[0]
+            expected = [int(t) for t in _greedy(model2, pin)]
+            pub = WeightPublisher(
+                router, ck,
+                config=PublisherConfig(
+                    CanaryConfig(prompts=[(pin, expected)],
+                                 require_zero_compiles=True),
+                    drain_timeout_s=60),
+                registry=reg, health=health)
+            assert pub.current.version == "v1"
+            assert {pool[n].weight_version
+                    for n in pool.names} == {"v1"}
+            assert pub.poll_once() is None       # nothing new yet
+
+            before = _prompts([5, 7, 6, 4], seed=51)
+            for i, p in enumerate(before):
+                router.submit(("a", i), p)
+            router.wait_all(timeout=120)
+
+            write_model_checkpoint(ck, model2, neval=2)
+            during = _prompts([6, 5, 7, 4, 6, 5], seed=52)
+            for i, p in enumerate(during):
+                router.submit(("b", i), p)       # in flight and queued
+            report = pub.poll_once()             # ... while we publish
+            assert report is not None and report.outcome == "ok"
+            assert report.canary.passed
+            assert report.canary.compiles == 0   # warm spin-up
+            assert sorted(report.rolled) == ["r0", "r1"]
+            router.wait_all(timeout=120)
+
+            after = _prompts([6, 5], seed=53)
+            for i, p in enumerate(after):
+                router.submit(("c", i), p)
+            router.wait_all(timeout=120)
+
+            res = dict(router.finished())
+            want_ids = ([("a", i) for i in range(4)]
+                        + [("b", i) for i in range(6)]
+                        + [("c", i) for i in range(2)])
+            assert sorted(res) == sorted(want_ids)   # exactly once
+            for i, p in enumerate(before):       # pre-publish: old
+                np.testing.assert_array_equal(res[("a", i)],
+                                              _greedy(model, p))
+            for i, p in enumerate(during):       # skew window: exactly
+                old, new = _greedy(model, p), _greedy(model2, p)  # one
+                assert not np.array_equal(old, new)
+                got = res[("b", i)]
+                assert (np.array_equal(got, old)
+                        or np.array_equal(got, new)), f"req b{i}"
+            for i, p in enumerate(after):        # post-publish: new
+                np.testing.assert_array_equal(res[("c", i)],
+                                              _greedy(model2, p))
+
+            assert {pool[n].weight_version
+                    for n in pool.names} == {"v2"}
+            assert "canary" not in pool.names    # retired
+            assert pub.current.version == "v2"
+            assert reg.get("publisher_current_neval").value() == 2
+            assert reg.get("publisher_publishes_total") \
+                      .value(outcome="ok") == 1
+            assert reg.get("publisher_replicas_rolled_total") \
+                      .value() == 2
+            assert reg.get("publisher_rollout_in_progress") \
+                      .value() == 0
+            # future spin-ups build on the published weights
+            assert pool.add_replica("r9", warm=False) \
+                       .weight_version == "v2"
+        finally:
+            if pub is not None:
+                pub.close()
+            router.close()
+            pool.close()
+
+    def test_failed_canary_rolls_nothing(self, model, model2,
+                                         tmp_path):
+        """Rollback drill: the canary fails pinned-prompt parity ->
+        the fleet stays 100% on the old version, zero dropped
+        requests, and the canary replica is gone."""
+        ck = str(tmp_path / "ck")
+        write_model_checkpoint(ck, model, neval=1)
+        health, reg, pool, router = _plane(model)
+        pub = None
+        try:
+            pin = _prompts([6], seed=60)[0]
+            # deliberately expect the OLD model's continuation: the v2
+            # canary must diverge and fail qualification
+            wrong = [int(t) for t in _greedy(model, pin)]
+            assert wrong != [int(t) for t in _greedy(model2, pin)]
+            pub = WeightPublisher(
+                router, ck,
+                config=PublisherConfig(
+                    CanaryConfig(prompts=[(pin, wrong)])),
+                registry=reg, health=health)
+            write_model_checkpoint(ck, model2, neval=2)
+            prompts = _prompts([5, 6, 7, 4], seed=61)
+            for i, p in enumerate(prompts):
+                router.submit(i, p)
+            report = pub.poll_once()
+            assert report.outcome == "canary_failed"
+            assert not report.canary.passed
+            assert "parity" in report.error
+            assert report.rolled == []           # fleet untouched
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == list(range(4))  # zero dropped
+            for i, p in enumerate(prompts):
+                np.testing.assert_array_equal(res[i],
+                                              _greedy(model, p))
+            assert {pool[n].weight_version
+                    for n in pool.names} == {"v1"}
+            assert "canary" not in pool.names
+            assert pub.current.version == "v1"
+            assert reg.get("publisher_rollbacks_total").value() == 1
+            assert reg.get("publisher_publishes_total") \
+                      .value(outcome="canary_failed") == 1
+        finally:
+            if pub is not None:
+                pub.close()
+            router.close()
+            pool.close()
+
+    def test_mid_rollout_failure_restores_every_replica(
+            self, model, model2, tmp_path):
+        """A failure AFTER some replicas already rolled re-installs the
+        prior version on each of them (reverse order) — the fleet is
+        never left partially downgraded, and keeps serving."""
+        ck = str(tmp_path / "ck")
+        write_model_checkpoint(ck, model, neval=1)
+        health, reg, pool, router = _plane(model)
+        pub = None
+        try:
+            pin = _prompts([6], seed=70)[0]
+            expected = [int(t) for t in _greedy(model2, pin)]
+            pub = WeightPublisher(
+                router, ck,
+                config=PublisherConfig(
+                    CanaryConfig(prompts=[(pin, expected)])),
+                registry=reg, health=health)
+            write_model_checkpoint(ck, model2, neval=2)
+
+            def _boom(model=None, *, weight_version):
+                raise RuntimeError("injected swap failure")
+            pool["r1"].set_weights = _boom       # second install dies
+            report = pub.poll_once()
+            del pool["r1"].set_weights
+            assert report.outcome == "rolled_back"
+            assert report.rolled == ["r0"]
+            assert report.rolled_back == ["r0"]
+            assert "injected swap failure" in report.error
+            assert {pool[n].weight_version
+                    for n in pool.names} == {"v1"}
+            assert pub.current.version == "v1"
+            assert reg.get("publisher_rollbacks_total").value() == 1
+            # both replicas resumed and serve the OLD weights
+            p = _prompts([5], seed=71)[0]
+            for i in range(4):                   # spans both replicas
+                router.submit(("post", i), p)
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == [("post", i) for i in range(4)]
+            for i in range(4):
+                np.testing.assert_array_equal(res[("post", i)],
+                                              _greedy(model, p))
+        finally:
+            if pub is not None:
+                pub.close()
+            router.close()
+            pool.close()
+
+    def test_error_outcome_when_checkpoint_unloadable(self, tmp_path):
+        """A manifest that points at missing member files publishes as
+        outcome='error' — the fleet is untouched and the poll loop
+        survives (no exception escapes)."""
+        d = str(tmp_path)
+        health, reg = HealthRegistry(), MetricRegistry()
+
+        class _FakeReplica:
+            name = "r0"
+            weight_version = None
+
+            def set_weights(self, model=None, *, weight_version):
+                self.weight_version = weight_version
+
+        class _FakePool:
+            model = object()
+            aot = None
+
+            def __init__(self):
+                self.replicas = {"r0": _FakeReplica()}
+
+            names = property(lambda self: list(self.replicas))
+
+            def __iter__(self):
+                return iter(self.replicas.values())
+
+            def __getitem__(self, n):
+                return self.replicas[n]
+
+            def set_default_model(self, model, *, weight_version=None):
+                pass
+
+        class _FakeRouter:
+            def __init__(self, pool):
+                self.pool = pool
+
+            def quarantine(self, name):
+                pass
+
+            def unquarantine(self, name):
+                pass
+
+        pub = WeightPublisher(_FakeRouter(_FakePool()), d,
+                              registry=reg, health=health)
+        try:
+            assert pub.current.version == "v0"   # empty dir baseline
+            assert pub.pool["r0"].weight_version == "v0"
+            assert pub.poll_once() is None
+            assert reg.get("publisher_polls_total").value() == 1
+            ok, results = health.run("liveness",
+                                     names=["weight_publisher"])
+            assert ok
+            # a manifest with no member files behind it
+            TestManifestPollFastPath._commit(d, 2)
+            report = pub.poll_once()
+            assert report.outcome == "error"
+            assert pub.current.version == "v0"   # fleet untouched
+            assert reg.get("publisher_publishes_total") \
+                      .value(outcome="error") == 1
+            assert len(pub.history) == 1
+        finally:
+            pub.close()
+        assert not health.checks(kind="liveness")  # unregistered
+
+
+# ---------------------------------------------------------------------------
+# canary qualification + live-traffic shadowing
+
+class TestCanaryAndShadow:
+    def test_quarantined_canary_qualifies_and_shadows(self, model,
+                                                      model2):
+        """A quarantined canary never receives live placements; replay
+        + SLO gates score it, and a ShadowTap mirrors every live
+        request (fraction=1.0) with full agreement for identical
+        weights."""
+        health, reg, pool, router = _plane(model)
+        try:
+            pin = _prompts([6], seed=80)[0]
+            router.quarantine("canary")
+            canary = pool.add_replica("canary", warm=False,
+                                      model=model,
+                                      weight_version="v1b")
+            with ShadowTap(router, canary, fraction=1.0) as tap:
+                prompts = _prompts([5, 6, 4], seed=81)
+                placed = [router.submit(i, p)
+                          for i, p in enumerate(prompts)]
+                assert "canary" not in placed    # quarantine holds
+                router.wait_all(timeout=120)
+                tap.wait(60)
+                shadow = tap.report()
+            assert shadow["shadowed"] == 3
+            assert shadow["samples"] == 3
+            assert shadow["agreement"] == 1.0
+            verdict = qualify(
+                canary,
+                CanaryConfig(
+                    prompts=[(pin,
+                              [int(t) for t in _greedy(model, pin)])],
+                    slo=SLOConfig(ttft_p99_s=120.0,
+                                  decode_token_p99_s=120.0),
+                    shadow_fraction=1.0, min_shadow_samples=3),
+                shadow_report=shadow)
+            assert verdict.passed, verdict.reasons
+            # a diverging expectation fails parity, loudly
+            bad = qualify(canary, CanaryConfig(
+                prompts=[(pin,
+                          [int(t) for t in _greedy(model2, pin)])]))
+            assert not bad.passed
+            assert any("parity" in r for r in bad.reasons)
+            assert bad.parity["mismatched"] == 1
+            # retire the way the publisher does
+            canary.drain_begin()
+            assert canary.wait_idle(60)
+            pool.remove_replica("canary")
+            router.unquarantine("canary")
+            res = dict(router.finished())
+            assert sorted(res) == [0, 1, 2]      # live results intact
+        finally:
+            router.close()
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# the real end-to-end drill (slow: background publisher thread +
+# concurrent trainer commits + live traffic)
+
+@pytest.mark.slow
+class TestEndToEndDrill:
+    def test_trainer_commits_while_fleet_serves(self, model, model2,
+                                                tmp_path):
+        import threading
+        import time as _time
+        ck = str(tmp_path / "ck")
+        write_model_checkpoint(ck, model, neval=1)
+        health, reg, pool, router = _plane(
+            model, aot_cache=str(tmp_path / "aot"))
+        pin = _prompts([6], seed=90)[0]
+        expected = [int(t) for t in _greedy(model2, pin)]
+        pub = WeightPublisher(
+            router, ck,
+            config=PublisherConfig(
+                CanaryConfig(prompts=[(pin, expected)],
+                             require_zero_compiles=True),
+                poll_interval_s=0.05, drain_timeout_s=60),
+            registry=reg, health=health)
+        try:
+            pub.start()
+            stop = threading.Event()
+            sent = []
+
+            def traffic():
+                prompts = _prompts([5, 6, 7, 4, 6], seed=91)
+                i = 0
+                while not stop.is_set():
+                    rid = ("t", i)
+                    try:
+                        router.submit(rid, prompts[i % len(prompts)])
+                    except Exception:
+                        _time.sleep(0.01)
+                        continue
+                    sent.append((rid, prompts[i % len(prompts)]))
+                    i += 1
+                    _time.sleep(0.01)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            _time.sleep(0.3)                    # serve v1 for a while
+            write_model_checkpoint(ck, model2, neval=2)
+            deadline = _time.monotonic() + 120
+            while (_time.monotonic() < deadline
+                   and not any(r.outcome == "ok" for r in pub.history)):
+                _time.sleep(0.05)
+            stop.set()
+            t.join(10)
+            router.wait_all(timeout=120)
+            report = [r for r in pub.history if r.outcome == "ok"][-1]
+            assert report.canary.compiles == 0
+            assert sorted(report.rolled) == ["r0", "r1"]
+            assert {pool[n].weight_version
+                    for n in pool.names} == {"v2"}
+            res = dict(router.finished())
+            assert sorted(res) == sorted(r for r, _ in sent)
+            for rid, p in sent:
+                old, new = _greedy(model, p), _greedy(model2, p)
+                got = res[rid]
+                assert (np.array_equal(got, old)
+                        or np.array_equal(got, new)), f"req {rid}"
+        finally:
+            pub.close()
+            router.close()
+            pool.close()

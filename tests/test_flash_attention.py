@@ -176,6 +176,7 @@ def test_ring_attention_flash_body_matches_full(causal):
         Engine.reset()
 
 
+@pytest.mark.slow  # ~15s mesh compile; sequence_parallel ring tests pin tier-1
 def test_ring_flash_guards():
     """Review r2: causal cross-length and undersized K/V shards must not
     take the flash ring body; flash=True raises, auto falls back."""

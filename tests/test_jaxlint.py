@@ -470,6 +470,33 @@ class TestJX5HostOnlyImports:
         """, rel="bigdl_tpu/elastic/checkpoint_writer.py")
         assert out == []
 
+    def test_deploy_subsystem_is_host_only(self):
+        """ISSUE 16 satellite pin: bigdl_tpu/deploy/ (weight publisher,
+        canary qualification, versioned weight sets) is host
+        orchestration over the replica API — a module-level jax import
+        in any of its modules is a JX5 finding (checkpoint loading and
+        the quantize round-trip lazy-import jax inside the functions
+        that issue them), and the shipped files are clean (baseline
+        stays empty)."""
+        for mod in ("__init__.py", "version.py", "canary.py",
+                    "publisher.py"):
+            rel = f"bigdl_tpu/deploy/{mod}"
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, "bigdl_tpu", "deploy", mod)
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+        # the sanctioned lazy-import load shape stays clean
+        out = lint("""
+            def load_weight_version(path):
+                from bigdl_tpu.elastic import load_checkpoint
+                return load_checkpoint(path)
+        """, rel="bigdl_tpu/deploy/version.py")
+        assert out == []
+
     def test_telemetry_plane_modules_are_covered(self):
         """Satellite pin: the host-only prefix covers the telemetry
         plane — a module-level jax import in exporter.py /

@@ -88,6 +88,7 @@ class TestInception:
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 320, 28, 28))
         assert fwd(blk, x).shape == (2, 160 + 96 + 320, 14, 14)
 
+    @pytest.mark.slow  # 224x224 compile ~9s; v1 + layer math pin the family
     def test_v2_no_aux_shape(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
         assert fwd(models.Inception_v2_NoAuxClassifier(10), x).shape == (1, 10)
@@ -110,6 +111,7 @@ class TestResNet:
         for depth in (20, 32):
             assert fwd(models.ResNet(10, {"depth": depth}), x).shape == (2, 10)
 
+    @pytest.mark.slow  # 224x224 compile ~11s; cifar depths pin the family
     def test_imagenet_bottleneck(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
         m = models.ResNet(7, {"depth": 50,
@@ -147,6 +149,7 @@ class TestSimpleRNN:
 
 
 class TestAlexNet:
+    @pytest.mark.slow  # 224x224 compile ~17s; caffe pins the family
     def test_owt_shape(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 224, 224))
         assert fwd(models.AlexNet_OWT(10), x).shape == (1, 10)

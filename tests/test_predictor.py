@@ -95,6 +95,7 @@ class TestDistriValidator:
 
 
 class TestTestMains:
+    @pytest.mark.slow  # ~13s vgg compile; rnn main pins the Test-CLI path
     def test_vgg_test_main(self, tmp_path):
         """End-to-end: save a model, evaluate it via the vgg Test CLI over
         a synthetic CIFAR binary folder."""

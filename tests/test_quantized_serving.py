@@ -157,9 +157,13 @@ class TestDecodeParity:
             [len(p) + self.N_NEW for p in prompts])
         return np.asarray(first), np.asarray(toks)
 
-    @pytest.mark.parametrize("kw", [{}, {"pos_encoding": "rope",
-                                         "num_kv_heads": 2}],
-                             ids=["learned", "rope-gqa"])
+    # rope-gqa adds ~9s of compile for the same parity property; the
+    # learned-pos variant pins it in tier-1
+    @pytest.mark.parametrize(
+        "kw", [{}, pytest.param({"pos_encoding": "rope",
+                                 "num_kv_heads": 2},
+                                marks=pytest.mark.slow)],
+        ids=["learned", "rope-gqa"])
     def test_dense_interpret_parity_and_fp32_tolerance(self, kw):
         """ISSUE 15 acceptance: int8 parity on the dense AND
         interpret-mode paged paths. dense == interpret EXACTLY (same
