@@ -25,8 +25,8 @@ Quick start::
         CanaryConfig(prompts=[(pinned_prompt, expected_tokens)],
                      slo=SLOConfig(), require_zero_compiles=True)))
     pub.start()            # rolls every newer checkpoint the trainer
-    ...                    # commits; pub.history has the outcomes
-    pub.close()
+    ...                    # commits; pub.history_snapshot() has the
+    pub.close()            # outcomes, pub.serving the live manifest
 
 HOST-ONLY CONTRACT: nothing in this package imports jax at module top
 level (jaxlint JX5) — deployment is host orchestration; device work

@@ -40,7 +40,14 @@ log).
 
 HOST-ONLY CONTRACT (jaxlint JX5): no module-level jax import; device
 work happens inside the batchers the pool already owns.
+
+Lock order (enforced by dev/analysis/raceguard.py TS1): the
+publisher's ``_mu`` is a leaf — it guards only the poll-thread state
+(``current``/``history``/``_last_poll``) and is never held while
+calling into the router or a replica; the rollout path (drain ->
+set_weights -> resume) runs entirely lock-free on the poll thread.
 """
+# raceguard: order weightpublisher.mu < state_lock < replica.lock
 from __future__ import annotations
 
 import logging
@@ -213,6 +220,11 @@ class WeightPublisher:
 
         self._stop = False
         self._started = False
+        # _mu guards the state the poll thread writes and other
+        # threads read: ``current``, ``history``, ``_last_poll``. It
+        # is a leaf lock — never held across router/replica calls
+        # (see the "raceguard: order" declaration at module top).
+        self._mu = threading.Lock()
         self._last_poll = time.monotonic()
         self._wake = threading.Event()
         self._thread = threading.Thread(
@@ -252,21 +264,39 @@ class WeightPublisher:
         if self._started and not self._thread.is_alive() \
                 and not self._stop:
             return False, "publisher thread died"
-        age = time.monotonic() - self._last_poll
+        # health checks run on the MetricsServer thread: snapshot the
+        # poll-thread-written state under _mu, format outside it
+        with self._mu:
+            age = time.monotonic() - self._last_poll
+            version, neval = self.current.version, self.current.neval
         if self._started and age > max(self.config.liveness_grace_s,
                                        2 * self.config.poll_interval_s):
             return False, (f"no poll for {age:.1f}s (serving "
-                           f"{self.current.version})")
-        return True, (f"serving {self.current.version} "
-                      f"(neval={self.current.neval}); last poll "
-                      f"{age:.1f}s ago")
+                           f"{version})")
+        return True, (f"serving {version} (neval={neval}); "
+                      f"last poll {age:.1f}s ago")
+
+    def history_snapshot(self) -> list:
+        """Atomic copy of the publish history. The deque is appended
+        on the poll thread; callers iterating ``history`` live would
+        race a concurrent publish — take the snapshot instead."""
+        with self._mu:
+            return list(self.history)
+
+    @property
+    def serving(self) -> WeightManifest:
+        """The manifest the fleet currently serves, read atomically
+        (``current`` is swapped by the poll thread at rollout end)."""
+        with self._mu:
+            return self.current
 
     # -- the loop body --
     def poll_once(self):
         """One poll: return ``None`` when nothing new is committed,
         else the :class:`PublishReport` of the publish it triggered."""
         self._m_polls.inc()
-        self._last_poll = time.monotonic()
+        with self._mu:
+            self._last_poll = time.monotonic()
         man = self._latest_checkpoint(self.checkpoint_dir,
                                       cache=self._poll_cache)
         if man is None or int(man["neval"]) <= self.current.neval:
@@ -300,7 +330,8 @@ class WeightPublisher:
         self._m_publishes.inc(outcome=report.outcome)
         if report.outcome in ("canary_failed", "rolled_back"):
             self._m_rollbacks.inc()
-        self.history.append(report)
+        with self._mu:
+            self.history.append(report)
         trace.instant("publish finished", cat="deploy",
                       outcome=report.outcome, version=version,
                       duration_s=round(report.duration_s, 4))
@@ -424,7 +455,8 @@ class WeightPublisher:
         # the fleet is 100% on the new version: future spin-ups
         # (autoscaler add_replica) must build with it too
         self.pool.set_default_model(wm.model, weight_version=wm.version)
-        self.current = wm
+        with self._mu:
+            self.current = wm
         self._g_neval.set(wm.neval)
         return PublishReport("ok", wm.version, wm.neval, canary=verdict,
                              rolled=rolled,

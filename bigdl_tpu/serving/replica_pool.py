@@ -28,12 +28,16 @@ Thread contract: the driver thread is the only caller of ``step()``;
 router threads call ``submit``/``cancel``/``export``/``stats`` under
 the same lock. A ``step()`` in flight simply delays those calls by one
 burst. Locks are re-entrant so batcher hooks (``on_complete``) may
-fire router code on the driver thread.
+fire router code on the driver thread — that hook takes the router's
+``_state_lock`` while holding ``replica.lock``, which is the ONE
+sanctioned nesting direction; the declaration below has raceguard
+(TS1) reject the inverse anywhere in the plane.
 
 HOST-ONLY CONTRACT: never imports jax (jaxlint JX5). The batcher class
 is imported lazily inside :class:`ReplicaPool` construction, so this
 module stays importable in jax-free tooling.
 """
+# raceguard: order state_lock < replica.lock
 from __future__ import annotations
 
 import threading

@@ -34,12 +34,16 @@ Locking: ``_state_lock`` guards only the router's own dicts and is
 never held while a replica lock is being acquired; replica driver
 threads call back into ``_on_complete`` holding their replica lock and
 take ``_state_lock`` briefly. That one-way order (replica -> state) is
-what makes the plane deadlock-free. The pending queue is flushed by a
-single dispatcher thread, so batcher-level arrival order is preserved.
+what makes the plane deadlock-free, and the declaration below turns it
+into a machine-checked gate (dev/analysis/raceguard.py TS1): acquiring
+``replica.lock`` anywhere while ``state_lock`` is held is a lint
+failure. The pending queue is flushed by a single dispatcher thread,
+so batcher-level arrival order is preserved.
 
 HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — routing is pure
 host orchestration over the batcher API.
 """
+# raceguard: order state_lock < replica.lock
 from __future__ import annotations
 
 import threading

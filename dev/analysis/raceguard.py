@@ -1,0 +1,926 @@
+"""raceguard — lock-order & thread-safety analyzer for the host plane.
+
+The serving/elastic/deploy control plane is deeply threaded (Router
+dispatcher, Replica driver threads, Autoscaler, WeightPublisher,
+CheckpointWriter, PrefetchIterator, MetricsServer), and its
+deadlock-freedom contracts used to exist only as prose ("state lock
+never held across replica locks" — serving/router.py). This module is
+the second analyzer pass next to ``jaxlint``: dependency-free (stdlib
+``ast`` only; never imports jax), sharing jaxlint's loader,
+suppression comments and shrink-only baseline machinery, and wired
+into ``dev/lint.py`` as the ``TS`` rule family.
+
+Rules (see docs/STATIC_ANALYSIS.md "Concurrency rules"):
+
+- TS1  lock-order inversion. Every ``with <lock>:`` / ``.acquire()``
+       site contributes a node to a REPO-GLOBAL lock graph (locks are
+       identified by attribute name, qualified by class for generic
+       names like ``lock``); an edge A -> B means "B was acquired
+       while A was held", including acquisitions reached through
+       resolvable method calls. Cycles are flagged, as is any edge
+       that contradicts a declared order annotation::
+
+           # raceguard: order state_lock < replica.lock
+
+       reads "``state_lock`` is INNER to ``replica.lock``": a thread
+       holding ``state_lock`` must never acquire ``replica.lock``;
+       the reverse nesting is the sanctioned one. A non-reentrant
+       ``threading.Lock`` re-acquired while already held (directly or
+       through a ``self.`` call) is a guaranteed deadlock and also
+       TS1.
+- TS2  blocking call while holding a lock: ``queue.get/put`` (the
+       blocking forms), ``Thread.join``, ``Event.wait``,
+       socket/HTTP/subprocess calls, ``time.sleep`` and
+       ``jax.device_get`` inside a ``with <lock>`` body — directly or
+       through a same-class/same-module callee. ``Condition.wait`` /
+       ``wait_for`` on the condition being held is exempt (it
+       releases the lock while parked).
+- TS3  shared mutable attribute written from a ``Thread(target=...)``
+       -reachable method with no lock held on that path, when the
+       same attribute is read or written by non-thread methods (or is
+       public API surface — no leading underscore — and therefore
+       readable from any thread).
+- TS4  non-daemon thread creation (a stuck worker must never hold
+       the process alive), or a ``close()``/``shutdown()``/``stop()``
+       that joins a thread without a timeout (an unbounded join in
+       teardown wedges the caller behind the very thread being
+       retired).
+- TS5  ``Condition.wait`` outside a ``while``-predicate loop (the
+       lost/spurious-wakeup bug); ``wait_for`` loops internally and
+       is the sanctioned form.
+
+What the rules deliberately do NOT catch (kept out to stay
+zero-false-positive on the shipped tree): cross-instance aliasing
+(two instances of one class are one graph node), hook closures
+invoked from foreign threads (``on_complete`` taps), writes from
+NON-thread methods racing thread-side reads (the quarantine set's
+documented "racy read by design" probes), and calls whose receiver
+cannot be matched to a scanned class by name (the batcher's internals
+live outside the scan scope). Declared-order annotations are the
+backstop that makes the important contracts checkable anyway.
+
+Suppression: the shared ``# jaxlint: disable=TS2`` comment syntax.
+Baseline: the shared ``dev/analysis/baseline.txt`` with the same
+``path:RULE:stripped-source-line`` fingerprints.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+try:                                    # package import (tests, lint)
+    from analysis import jaxlint
+except ImportError:                     # direct sibling import
+    import jaxlint  # type: ignore
+
+__all__ = ["RULES", "SCAN_PREFIXES", "analyze_source", "analyze_files"]
+
+RULES = {
+    "TS1": "lock-order inversion (cycle, declared order, re-acquire)",
+    "TS2": "blocking call while holding a lock",
+    "TS3": "shared attribute written on a thread with no lock held",
+    "TS4": "non-daemon thread, or teardown join without a timeout",
+    "TS5": "Condition.wait outside a while-predicate loop",
+}
+
+# the threaded host plane this pass runs over (relative, /-separated);
+# everything else is skipped so e.g. tests may use raw threads freely
+SCAN_PREFIXES = (
+    "bigdl_tpu/serving/",
+    "bigdl_tpu/elastic/",
+    "bigdl_tpu/deploy/",
+    "bigdl_tpu/dataset/prefetch.py",
+    "bigdl_tpu/observability/",
+    "scripts/",
+)
+
+_LOCK_TYPES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "cond",
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+}
+_QUEUE_TYPES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue"}
+_THREAD_TYPES = {"threading.Thread"}
+_EVENT_TYPES = {"threading.Event"}
+
+# attribute names too generic to be a global lock identity on their
+# own: qualify with the owning class (``Replica.lock`` ->
+# ``replica.lock``), which is exactly the annotation spelling
+_GENERIC_LOCK_NAMES = {"lock", "rlock", "mutex", "mu", "cond",
+                       "condition", "sem"}
+
+# dotted-name prefixes whose calls park the calling thread
+_BLOCKING_QUALS = ("time.sleep", "jax.device_get", "subprocess.",
+                   "socket.", "urllib.request.", "requests.",
+                   "http.client.")
+
+# container mutators: ``self.attr.append(...)`` counts as a write to
+# ``attr`` for TS3 (deque/list/set/dict surface; ``put``/``set`` stay
+# out — queues have their own locking and metric gauges use ``set``)
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "discard", "remove", "insert", "clear", "pop", "popleft",
+             "popitem", "update", "setdefault"}
+
+_TEARDOWN_METHODS = {"close", "shutdown", "stop", "__exit__",
+                     "__del__"}
+
+_ORDER_RE = re.compile(r"#\s*raceguard:\s*order\s+([^#]+)")
+_ORDER_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.]*$")
+
+
+def _lock_identity(attr: str, owner: str | None) -> str:
+    """Global identity of a lock attribute/variable: the name with
+    leading underscores stripped; generic names are qualified by the
+    owning class (lowercased) so ``Replica.lock`` and
+    ``PrefixCache._lock`` stay distinct graph nodes."""
+    base = attr.lstrip("_") or attr
+    if base.lower() in _GENERIC_LOCK_NAMES and owner:
+        return f"{owner.lower()}.{base}"
+    return base
+
+
+def _ctor_kind(mod, node):
+    """Sync-primitive kind ('lock'/'rlock'/'cond'/'queue'/'thread'/
+    'event') constructed by ``node``, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    q = mod.qual(node.func)
+    if q in _LOCK_TYPES:
+        return _LOCK_TYPES[q]
+    if q in _QUEUE_TYPES:
+        return "queue"
+    if q in _THREAD_TYPES:
+        return "thread"
+    if q in _EVENT_TYPES:
+        return "event"
+    return None
+
+
+def _ann_kind(mod, node):
+    """Kind from a type annotation (``threading.Thread | None``)."""
+    if isinstance(node, ast.BinOp):
+        return _ann_kind(mod, node.left) or _ann_kind(mod, node.right)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        q = mod.qual(node)
+        if q in _LOCK_TYPES:
+            return _LOCK_TYPES[q]
+        if q in _QUEUE_TYPES:
+            return "queue"
+        if q in _THREAD_TYPES:
+            return "thread"
+        if q in _EVENT_TYPES:
+            return "event"
+    return None
+
+
+def _hint_of(node):
+    """Receiver naming hint for attribute-call resolution: the
+    innermost attribute/variable name (``self.pool[n].submit`` ->
+    ``pool``; ``rep.stop`` -> ``rep``)."""
+    while isinstance(node, (ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr.lstrip("_")
+    if isinstance(node, ast.Name) and node.id != "self":
+        return node.id.lstrip("_")
+    return None
+
+
+def _self_attr(node):
+    """``self.X`` -> 'X', else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _has_nowait(call: ast.Call) -> bool:
+    """``get(block=False)`` / ``put(..., block=False)``."""
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+class _ClassInfo:
+    """One scanned class: its methods, typed sync attributes, and the
+    methods its own ``threading.Thread(target=self.X)`` sites name."""
+
+    __slots__ = ("name", "mod", "attr_types", "summaries",
+                 "thread_targets", "method_names")
+
+    def __init__(self, name, mod):
+        self.name = name
+        self.mod = mod
+        self.attr_types = {}        # attr -> kind
+        self.summaries = {}         # method name -> _FnSummary
+        self.thread_targets = set()
+        self.method_names = set()
+
+    def lock_id(self, attr: str) -> str:
+        return _lock_identity(attr, self.name)
+
+
+class _FnSummary:
+    """Everything one function body contributes to the global rules."""
+
+    __slots__ = ("mod", "cls", "name", "label", "acquires", "calls",
+                 "writes", "reads", "blocks", "joins", "threads",
+                 "waits", "daemon_assigned")
+
+    def __init__(self, mod, cls, name, label):
+        self.mod = mod
+        self.cls = cls              # _ClassInfo | None
+        self.name = name
+        self.label = label          # e.g. "Router.drain"
+        self.acquires = []          # (lock_id, kind, line, held)
+        self.calls = []             # (ckind, name, hint, line, held)
+        self.writes = []            # (attr, line, held)
+        self.reads = set()          # self-attrs read anywhere
+        self.blocks = []            # (desc, line, held)
+        self.joins = []             # (line, has_timeout)  thread joins
+        self.threads = []           # (line, daemon_ok)    Thread(...)
+        self.waits = []             # (line, in_while)     Cond.wait
+        self.daemon_assigned = False
+
+
+class _FnScan:
+    """Walk one function body tracking the held-lock set along the
+    statement structure (with-blocks, linear acquire()/release(),
+    branch-local copies)."""
+
+    def __init__(self, finfo, cls, fn, label):
+        self.finfo = finfo
+        self.mod = finfo.mod
+        self.cls = cls
+        self.fn = fn
+        self.s = _FnSummary(self.mod, cls, fn.name, label)
+        self.while_depth = 0
+        self.local_types = self._local_types(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                a = _self_attr(node)
+                if a is not None:
+                    self.s.reads.add(a)
+        self._scan_block(fn.body, [])
+
+    # -- typing ----------------------------------------------------
+
+    def _local_types(self, fn):
+        types = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                k = _ctor_kind(self.mod, node.value)
+                if k:
+                    types[node.targets[0].id] = k
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                k = (_ctor_kind(self.mod, node.value)
+                     or _ann_kind(self.mod, node.annotation))
+                if k:
+                    types[node.target.id] = k
+        return types
+
+    def _recv_kind(self, node):
+        """(kind, lock_identity) of a receiver expression, or
+        (None, None) when untyped."""
+        a = _self_attr(node)
+        if a is not None and self.cls is not None:
+            k = self.cls.attr_types.get(a)
+            if k:
+                return k, self.cls.lock_id(a)
+            return None, None
+        if isinstance(node, ast.Name):
+            k = self.local_types.get(node.id)
+            owner = self.cls.name if self.cls else self.finfo.stem
+            if k:
+                return k, _lock_identity(node.id, owner)
+            k = self.finfo.module_types.get(node.id)
+            if k:
+                return k, _lock_identity(node.id, self.finfo.stem)
+        return None, None
+
+    def _lock_of(self, expr):
+        """(identity, kind) when ``expr`` names a lock/condition."""
+        k, ident = self._recv_kind(expr)
+        if k in ("lock", "rlock", "cond"):
+            return ident, k
+        return None
+
+    # -- statement walk --------------------------------------------
+
+    def _scan_block(self, stmts, held):
+        held = list(held)           # linear acquire() stays in-block
+        for st in stmts:
+            self._scan_stmt(st, held)
+
+    def _scan_stmt(self, st, held):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure runs later, on whatever thread invokes it:
+            # scan it as its own (anonymous) summary with nothing held
+            sub = _FnScan(self.finfo, self.cls, st,
+                          f"{self.s.label}.<locals>.{st.name}")
+            self.finfo.anon.append(sub.s)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            cur = list(held)
+            for item in st.items:
+                self._scan_expr(item.context_expr, cur)
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    self.s.acquires.append(
+                        (lk[0], lk[1], st.lineno, tuple(cur)))
+                    cur.append(lk)
+            self._scan_block(st.body, cur)
+            return
+        if isinstance(st, ast.If):
+            self._scan_expr(st.test, held)
+            self._scan_block(st.body, held)
+            self._scan_block(st.orelse, held)
+            return
+        if isinstance(st, ast.While):
+            self._scan_expr(st.test, held)
+            self.while_depth += 1
+            self._scan_block(st.body, held)
+            self.while_depth -= 1
+            self._scan_block(st.orelse, held)
+            return
+        if isinstance(st, ast.For):
+            self._scan_expr(st.iter, held)
+            self._scan_block(st.body, held)
+            self._scan_block(st.orelse, held)
+            return
+        if isinstance(st, ast.Try):
+            self._scan_block(st.body, held)
+            for h in st.handlers:
+                self._scan_block(h.body, held)
+            self._scan_block(st.orelse, held)
+            self._scan_block(st.finalbody, held)
+            return
+        # simple statement: writes, then every call inside it
+        self._detect_writes(st, held)
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+        self._linear_lock_ops(st, held)
+
+    def _linear_lock_ops(self, st, held):
+        """``l.acquire()`` / ``l.release()`` as bare statements extend
+        or shrink the held set for the rest of the block."""
+        if not (isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)):
+            return
+        lk = self._lock_of(st.value.func.value)
+        if lk is None:
+            return
+        if st.value.func.attr == "acquire":
+            held.append(lk)
+        elif st.value.func.attr == "release" and lk in held:
+            held.remove(lk)
+
+    def _detect_writes(self, st, held):
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = list(st.targets)
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    self.s.daemon_assigned = True
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        elif isinstance(st, ast.Delete):
+            targets = list(st.targets)
+        for t in targets:
+            self._record_write_target(t, st.lineno, held)
+
+    def _record_write_target(self, t, line, held):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._record_write_target(e, line, held)
+            return
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        a = _self_attr(t)
+        if a is not None:
+            self.s.writes.append((a, line, tuple(held)))
+
+    # -- expression walk (calls) -----------------------------------
+
+    def _scan_expr(self, expr, held):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._on_call(node, held)
+
+    def _on_call(self, call, held):
+        func = call.func
+        q = self.mod.qual(func)
+        if q is not None:
+            if q == "threading.Thread":
+                self._on_thread_ctor(call)
+            for pat in _BLOCKING_QUALS:
+                if q == pat or (pat.endswith(".")
+                                and q.startswith(pat)):
+                    self.s.blocks.append((q, call.lineno, tuple(held)))
+                    return
+        if isinstance(func, ast.Name):
+            self.s.calls.append(
+                ("bare", func.id, None, call.lineno, tuple(held)))
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        recv, m = func.value, func.attr
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if self.cls is not None and m in self.cls.method_names:
+                self.s.calls.append(
+                    ("self", m, None, call.lineno, tuple(held)))
+            return
+        kind, ident = self._recv_kind(recv)
+        if kind == "queue":
+            if m in ("get", "put", "join") and not _has_nowait(call):
+                self.s.blocks.append(
+                    (f"queue.{m}", call.lineno, tuple(held)))
+            return
+        if kind == "thread":
+            if m == "join":
+                self.s.joins.append((call.lineno, _has_timeout(call)))
+                self.s.blocks.append(
+                    ("Thread.join", call.lineno, tuple(held)))
+            return
+        if kind == "event":
+            if m == "wait":
+                self.s.blocks.append(
+                    ("Event.wait", call.lineno, tuple(held)))
+            return
+        if kind in ("lock", "rlock", "cond"):
+            if m == "acquire":
+                self.s.acquires.append(
+                    (ident, kind, call.lineno, tuple(held)))
+            elif kind == "cond" and m == "wait":
+                self.s.waits.append(
+                    (call.lineno, self.while_depth > 0))
+            # wait/wait_for on a held condition releases it: never a
+            # TS2 blocking event; on an un-held one it raises anyway
+            return
+        # untyped receiver: a cross-class method call, resolved later
+        # against the scanned-class index by name + receiver hint;
+        # container mutators on self attributes count as writes
+        root = _self_attr(recv)
+        if root is not None and m in _MUTATORS:
+            self.s.writes.append((root, call.lineno, tuple(held)))
+            return
+        self.s.calls.append(
+            ("attr", m, _hint_of(recv), call.lineno, tuple(held)))
+
+    def _on_thread_ctor(self, call):
+        daemon_ok = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True for kw in call.keywords)
+        self.s.threads.append((call.lineno, daemon_ok))
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            a = _self_attr(kw.value)
+            if a is not None and self.cls is not None:
+                self.cls.thread_targets.add(a)
+            elif isinstance(kw.value, ast.Name):
+                self.finfo.module_thread_targets.add(kw.value.id)
+
+
+class _FileInfo:
+    """Per-file collection pass: classes, module functions, typed
+    module globals and declared lock orders."""
+
+    def __init__(self, src, rel_path):
+        self.mod = jaxlint._Module(src, rel_path)
+        self.rel = self.mod.rel
+        self.stem = os.path.basename(rel_path).rsplit(".", 1)[0]
+        self.classes = {}           # name -> _ClassInfo
+        self.module_funcs = {}      # name -> _FnSummary
+        self.module_types = {}      # module-global name -> kind
+        self.module_thread_targets = set()
+        self.anon = []              # closure summaries
+        self.orders = []            # ([names...], line)
+        self._collect()
+
+    def _collect(self):
+        tree = self.mod.tree
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                k = _ctor_kind(self.mod, node.value)
+                if k:
+                    self.module_types[node.targets[0].id] = k
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                scan = _FnScan(self, None, node, node.name)
+                self.module_funcs[node.name] = scan.s
+        for i, line in enumerate(self.mod.lines, 1):
+            m = _ORDER_RE.search(line)
+            if m:
+                names = [t.strip() for t in m.group(1).split("<")]
+                if len(names) >= 2 and all(
+                        _ORDER_NAME_RE.match(t) for t in names):
+                    self.orders.append((names, i))
+
+    def _collect_class(self, node):
+        cls = _ClassInfo(node.name, self.mod)
+        methods = [n for n in node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        cls.method_names = {m.name for m in methods}
+        # typing pre-pass over every method: ``self.X = Lock()`` etc.
+        for fn in methods:
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1:
+                    a = _self_attr(sub.targets[0])
+                    if a is None:
+                        continue
+                    k = _ctor_kind(self.mod, sub.value)
+                    if k:
+                        cls.attr_types[a] = k
+                elif isinstance(sub, ast.AnnAssign):
+                    a = _self_attr(sub.target)
+                    if a is None:
+                        continue
+                    k = (_ctor_kind(self.mod, sub.value)
+                         or _ann_kind(self.mod, sub.annotation))
+                    if k:
+                        cls.attr_types[a] = k
+        for fn in methods:
+            scan = _FnScan(self, cls, fn, f"{cls.name}.{fn.name}")
+            cls.summaries[fn.name] = scan.s
+        self.classes[node.name] = cls
+
+
+class _Program:
+    """The cross-file pass: call resolution, acquisition closure,
+    lock graph, and rule emission."""
+
+    def __init__(self, infos):
+        self.infos = infos
+        self.classes = [c for i in infos for c in i.classes.values()]
+        self.by_method = {}         # method name -> [_ClassInfo]
+        for c in self.classes:
+            for name in c.summaries:
+                self.by_method.setdefault(name, []).append(c)
+        self.summaries = []
+        for i in infos:
+            self.summaries.extend(i.module_funcs.values())
+            self.summaries.extend(i.anon)
+        for c in self.classes:
+            self.summaries.extend(c.summaries.values())
+        self.acq = {id(s): frozenset() for s in self.summaries}
+        self.blk = {id(s): frozenset() for s in self.summaries}
+        self._close()
+
+    # -- resolution ------------------------------------------------
+
+    def _resolve(self, s, ckind, name, hint):
+        """Callee summaries a call may reach. ``self`` calls resolve
+        within the class, bare names within the module; attribute
+        calls match scanned classes by method name ONLY when the
+        receiver hint names the class (no hint match -> unresolved,
+        never a guessed edge)."""
+        if ckind == "self":
+            if s.cls is not None and name in s.cls.summaries:
+                return [s.cls.summaries[name]]
+            return []
+        if ckind == "bare":
+            for info in self.infos:
+                if info.mod is s.mod:
+                    t = info.module_funcs.get(name)
+                    return [t] if t is not None else []
+            return []
+        cands = self.by_method.get(name, ())
+        if not cands or hint is None:
+            return []
+        h = hint.lower()
+        out = [c.summaries[name] for c in cands
+               if h and (h in c.name.lower() or c.name.lower() in h)]
+        return out
+
+    # -- closures --------------------------------------------------
+
+    def _close(self):
+        """Fixpoint: locks each function may (transitively) acquire,
+        and whether it may (transitively) block. ``blk`` only closes
+        over same-class/same-module calls — cross-class blocking is
+        an ordering question (TS1), not a hold-a-lock-here one."""
+        changed = True
+        while changed:
+            changed = False
+            for s in self.summaries:
+                a = set(self.acq[id(s)])
+                b = set(self.blk[id(s)])
+                a.update((lid, k) for lid, k, _, _ in s.acquires)
+                b.update(d for d, _, _ in s.blocks)
+                for ckind, name, hint, _, _ in s.calls:
+                    for t in self._resolve(s, ckind, name, hint):
+                        a |= self.acq[id(t)]
+                        if ckind in ("self", "bare"):
+                            b |= self.blk[id(t)]
+                if a != self.acq[id(s)]:
+                    self.acq[id(s)] = frozenset(a)
+                    changed = True
+                if b != self.blk[id(s)]:
+                    self.blk[id(s)] = frozenset(b)
+                    changed = True
+
+    # -- TS1 -------------------------------------------------------
+
+    def _declared_pairs(self):
+        """(inner, outer) -> declaration site, transitively closed
+        over every ``# raceguard: order`` chain in the scan set."""
+        pairs = {}
+        for info in self.infos:
+            for names, line in info.orders:
+                for i in range(len(names)):
+                    for j in range(i + 1, len(names)):
+                        pairs.setdefault((names[i], names[j]),
+                                         (info.rel, line))
+        changed = True
+        while changed:
+            changed = False
+            for (a, b), site in list(pairs.items()):
+                for (c, d), _ in list(pairs.items()):
+                    if b == c and (a, d) not in pairs:
+                        pairs[(a, d)] = site
+                        changed = True
+        return pairs
+
+    def _edges(self):
+        """(held, acquired) -> first site (mod, line, via). Also
+        emits the non-reentrant re-acquire flavor of TS1 inline."""
+        edges = {}
+
+        def add(h, hk, lid, k, s, line, via):
+            if h == lid:
+                if k == "lock" and hk == "lock" and via is None:
+                    s.mod.emit(line, "TS1",
+                               f"non-reentrant lock '{lid}' "
+                               "re-acquired while already held "
+                               "(guaranteed self-deadlock)")
+                return
+            edges.setdefault((h, lid), (s.mod, line, via))
+
+        for s in self.summaries:
+            for lid, k, line, held in s.acquires:
+                for h, hk in held:
+                    add(h, hk, lid, k, s, line, None)
+            for ckind, name, hint, line, held in s.calls:
+                if not held:
+                    continue
+                for t in self._resolve(s, ckind, name, hint):
+                    for lid, k in self.acq[id(t)]:
+                        for h, hk in held:
+                            add(h, hk, lid, k, s, line,
+                                t.label)
+        return edges
+
+    def emit_ts1(self):
+        edges = self._edges()
+        pairs = self._declared_pairs()
+        for (inner, outer), (drel, dline) in pairs.items():
+            site = edges.get((inner, outer))
+            if site is None:
+                continue
+            mod, line, via = site
+            how = f" (via {via}())" if via else ""
+            mod.emit(line, "TS1",
+                     f"acquiring '{outer}' while holding '{inner}'"
+                     f"{how} violates the declared order "
+                     f"'{inner} < {outer}' ({drel}:{dline})")
+        # cycles among the remaining edges (Tarjan SCC)
+        graph = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            members = ", ".join(sorted(scc))
+            for (a, b), (mod, line, via) in edges.items():
+                if a in scc and b in scc:
+                    how = f" (via {via}())" if via else ""
+                    mod.emit(line, "TS1",
+                             f"lock-order cycle: '{b}' acquired "
+                             f"while holding '{a}'{how} — cycle "
+                             f"among {{{members}}}")
+
+    # -- TS2 -------------------------------------------------------
+
+    def emit_ts2(self):
+        for s in self.summaries:
+            for desc, line, held in s.blocks:
+                if held:
+                    locks = ", ".join(f"'{h}'" for h, _ in held)
+                    s.mod.emit(line, "TS2",
+                               f"blocking {desc} while holding "
+                               f"{locks}")
+            for ckind, name, hint, line, held in s.calls:
+                if not held or ckind not in ("self", "bare"):
+                    continue
+                for t in self._resolve(s, ckind, name, hint):
+                    b = self.blk[id(t)]
+                    if b:
+                        locks = ", ".join(f"'{h}'" for h, _ in held)
+                        s.mod.emit(
+                            line, "TS2",
+                            f"call to {name}() blocks "
+                            f"({sorted(b)[0]}) while holding {locks}")
+
+    # -- TS3 -------------------------------------------------------
+
+    def emit_ts3(self):
+        for cls in self.classes:
+            self._emit_ts3_class(cls)
+
+    def _emit_ts3_class(self, cls):
+        entries = {m for m in cls.thread_targets if m in cls.summaries}
+        if not entries:
+            return
+        reachable = set(entries)
+        unlocked = set(entries)     # reachable with NO lock held
+        changed = True
+        while changed:
+            changed = False
+            for m in list(reachable):
+                s = cls.summaries[m]
+                for ckind, name, _, _, held in s.calls:
+                    if ckind != "self" or name not in cls.summaries:
+                        continue
+                    if name not in reachable:
+                        reachable.add(name)
+                        changed = True
+                    if m in unlocked and not held \
+                            and name not in unlocked:
+                        unlocked.add(name)
+                        changed = True
+        outside = set()
+        for name, s in cls.summaries.items():
+            if name in reachable or name == "__init__":
+                continue
+            outside |= s.reads
+            outside |= {a for a, _, _ in s.writes}
+        for m in sorted(unlocked):
+            s = cls.summaries[m]
+            for attr, line, held in s.writes:
+                if held or attr in cls.attr_types:
+                    continue
+                public = not attr.startswith("_")
+                if attr not in outside and not public:
+                    continue
+                where = ("also accessed by non-thread methods"
+                         if attr in outside else
+                         "a public attribute (readable from any "
+                         "thread)")
+                s.mod.emit(line, "TS3",
+                           f"'{attr}' written on the "
+                           f"'{cls.name}.{m}' thread with no lock "
+                           f"held, but it is {where}")
+
+    # -- TS4 -------------------------------------------------------
+
+    def emit_ts4(self):
+        for s in self.summaries:
+            for line, daemon_ok in s.threads:
+                if not daemon_ok and not s.daemon_assigned:
+                    s.mod.emit(line, "TS4",
+                               "thread created without daemon=True "
+                               "(a stuck worker would hold the "
+                               "process alive)")
+            if s.name in _TEARDOWN_METHODS:
+                for line, has_timeout in s.joins:
+                    if not has_timeout:
+                        s.mod.emit(line, "TS4",
+                                   f"{s.name}() joins a thread "
+                                   "without a timeout (teardown can "
+                                   "wedge behind the thread being "
+                                   "retired)")
+
+    # -- TS5 -------------------------------------------------------
+
+    def emit_ts5(self):
+        for s in self.summaries:
+            for line, in_while in s.waits:
+                if not in_while:
+                    s.mod.emit(line, "TS5",
+                               "Condition.wait outside a while-"
+                               "predicate loop (spurious/lost "
+                               "wakeups; re-check the predicate, or "
+                               "use wait_for)")
+
+
+def _sccs(graph):
+    """Tarjan's strongly-connected components (iterative)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    out = []
+    counter = [0]
+
+    def strongconnect(root):
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                u = work[-1][0]
+                low[u] = min(low[u], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+    return out
+
+
+def _analyze(infos):
+    prog = _Program(infos)
+    prog.emit_ts1()
+    prog.emit_ts2()
+    prog.emit_ts3()
+    prog.emit_ts4()
+    prog.emit_ts5()
+    findings = []
+    for info in infos:
+        findings.extend(info.mod.findings.values())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_source(src, rel_path):
+    """Analyze one file's source (tests / single-file use). Returns
+    suppression-filtered findings; the baseline is repo-level and
+    applied by the caller (``dev/lint.py``)."""
+    try:
+        info = _FileInfo(src, rel_path)
+    except SyntaxError:
+        return []                   # dev/lint.py's E999 owns these
+    return _analyze([info])
+
+
+def analyze_files(paths, repo_root, *, scan_prefixes=SCAN_PREFIXES):
+    """Analyze every path under the TS scan scope as ONE program (the
+    lock graph and declared orders are global). Returns raw findings;
+    ``dev/lint.py`` applies the shared baseline."""
+    infos = []
+    for p in paths:
+        rel = os.path.relpath(p, repo_root).replace(os.sep, "/")
+        if not rel.startswith(scan_prefixes) or not rel.endswith(".py"):
+            continue
+        with open(p, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            infos.append(_FileInfo(src, rel))
+        except SyntaxError:
+            continue
+    if not infos:
+        return []
+    return _analyze(infos)

@@ -12,17 +12,29 @@ checker covering the rules that actually catch bugs in this codebase:
 - E722 bare except
 - JX1–JX5 TPU-correctness rules (hidden host syncs, PRNG key reuse,
   use-after-donation, collective axis names, host-only jax imports) —
-  delegated to the jaxlint analyzer in ``dev/analysis/`` and filtered
-  through its baseline (``dev/analysis/baseline.txt``); stale baseline
-  entries are findings too, so the baseline only ever shrinks. See
-  docs/STATIC_ANALYSIS.md.
+  delegated to the jaxlint analyzer in ``dev/analysis/``
+- TS1–TS5 concurrency rules (lock-order inversion against declared
+  ``# raceguard: order`` annotations, blocking calls under a lock,
+  unguarded thread-shared attributes, non-daemon threads/unbounded
+  teardown joins, naked ``Condition.wait``) — delegated to the
+  raceguard analyzer, which scans the threaded host plane
+  (serving/elastic/deploy/observability/prefetch + scripts/)
+
+Both analyzer passes share one suppression syntax
+(``# jaxlint: disable=RULE``) and one shrink-only baseline
+(``dev/analysis/baseline.txt``); stale baseline entries are findings
+too, so the baseline only ever shrinks. See docs/STATIC_ANALYSIS.md.
 
 Run: ``python dev/lint.py`` (exit 1 on findings). Scans bigdl_tpu/,
-tests/, dev/, scripts/, bench.py, __graft_entry__.py.
+tests/, dev/, scripts/, bench.py, __graft_entry__.py. ``--rules JX``
+or ``--rules TS`` runs one analyzer family alone (the classic
+E/F/W/B checks always run).
 
 ``--update-baseline`` rewrites the baseline from the current findings
-(after a refactor that moves grandfathered code); ``--no-baseline``
-shows every JX finding including grandfathered ones (burn-down view).
+(after a refactor that moves grandfathered code; run it with the
+default ``--rules JX,TS`` so neither family's entries are dropped);
+``--no-baseline`` shows every analyzer finding including
+grandfathered ones (burn-down view).
 """
 from __future__ import annotations
 
@@ -33,6 +45,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from analysis import jaxlint  # noqa: E402
+from analysis import raceguard  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = ["bigdl_tpu", "tests", "dev", "scripts", "bench.py",
@@ -130,24 +143,32 @@ def lint_file(path):
     return findings
 
 
-def run_jaxlint(paths, *, baseline=True):
-    """JX findings over ``paths``, baseline-filtered. Returns
-    ``(findings, stale_entries)`` as printable tuples."""
-    all_jx = []
-    for p in paths:
-        all_jx.extend(jaxlint.analyze_file(p, REPO))
+def run_jaxlint(paths, *, baseline=True, rules=("JX", "TS")):
+    """Analyzer findings (JX jaxlint + TS raceguard, per ``rules``)
+    over ``paths``, baseline-filtered. Returns
+    ``(printable_tuples, raw_findings)``. Baseline entries are
+    filtered to the selected rule families, so a ``--rules JX`` run
+    never reports the TS entries as stale (or vice versa)."""
+    raw = []
+    if "JX" in rules:
+        for p in paths:
+            raw.extend(jaxlint.analyze_file(p, REPO))
+    if "TS" in rules:
+        raw.extend(raceguard.analyze_files(paths, REPO))
     if baseline:
-        entries = jaxlint.load_baseline()
-        new, stale = jaxlint.apply_baseline(all_jx, entries)
+        fams = {r[:2] for r in rules}
+        entries = [e for e in jaxlint.load_baseline()
+                   if e[1][:2] in fams]
+        new, stale = jaxlint.apply_baseline(raw, entries)
     else:
-        new, stale = all_jx, []
+        new, stale = raw, []
     out = [(f.path, f.line, f"{f.rule} {f.msg}") for f in new]
     out += [(jaxlint.BASELINE_PATH and
              os.path.relpath(jaxlint.BASELINE_PATH, REPO), 0,
              f"JLB stale baseline entry (finding is gone — prune it): "
              f"{e[0]}:{e[1]}:{e[2]}")
             for e in stale]
-    return out, all_jx
+    return out, raw
 
 
 def main(argv=None):
@@ -156,17 +177,26 @@ def main(argv=None):
                         help="show grandfathered JX findings too")
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite dev/analysis/baseline.txt from "
-                             "the current JX findings")
+                             "the current analyzer findings")
+    parser.add_argument("--rules", default="JX,TS",
+                        help="analyzer families to run (JX, TS, or "
+                             "JX,TS — default both)")
     args = parser.parse_args(argv)
+    rules = tuple(r.strip().upper()
+                  for r in args.rules.split(",") if r.strip())
+    bad = [r for r in rules if r not in ("JX", "TS")]
+    if bad or not rules:
+        parser.error(f"--rules takes JX and/or TS, got {args.rules!r}")
 
     paths = list(_files())
     all_findings = []
     for path in paths:
         all_findings.extend(lint_file(path))
-    jx, all_jx = run_jaxlint(paths, baseline=not args.no_baseline)
+    jx, all_jx = run_jaxlint(paths, baseline=not args.no_baseline,
+                             rules=rules)
     if args.update_baseline:
         with open(jaxlint.BASELINE_PATH, "w", encoding="utf-8") as f:
-            f.write("# jaxlint baseline — grandfathered findings "
+            f.write("# analyzer baseline — grandfathered findings "
                     "(path:RULE:source-line).\n"
                     "# Regenerate: python dev/lint.py "
                     "--update-baseline. Only ever shrink this file.\n")

@@ -39,7 +39,8 @@ MODULES = {
                 "tests/test_quantized_serving.py"],
     "deploy": ["tests/test_deploy.py"],
     "harness": ["tests/test_bench_contract.py"],
-    "lint": ["tests/test_jaxlint.py", "tests/test_lint_clean.py"],
+    "lint": ["tests/test_jaxlint.py", "tests/test_raceguard.py",
+             "tests/test_lint_clean.py"],
     "interop": ["tests/test_caffe.py", "tests/test_torchfile.py"],
     "examples": ["tests/test_examples.py",
                  "tests/test_textclassification.py"],

@@ -22,6 +22,9 @@ The load-bearing invariants, all CPU-pinned on a tiny model:
 """
 import json
 import os
+import threading
+from collections import deque
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -599,6 +602,72 @@ class TestWeightPublisher:
 
 
 # ---------------------------------------------------------------------------
+# publisher cross-thread state (raceguard TS3 regression)
+
+class TestPublisherThreadSafety:
+    """Regression for the raceguard TS3 findings on the publisher:
+    ``current``/``history``/``_last_poll`` are written on the poll
+    thread and read from the health-check thread (``_alive``) and by
+    external callers — now guarded by ``_mu`` with atomic snapshot
+    accessors (``history_snapshot``/``serving``)."""
+
+    def _bare_pub(self):
+        pub = WeightPublisher.__new__(WeightPublisher)
+        pub._mu = threading.Lock()
+        pub.history = deque(maxlen=64)
+        pub.current = SimpleNamespace(version="v1", neval=1)
+        pub._last_poll = 0.0
+        pub._stop = False
+        pub._started = False
+        pub.checkpoint_dir = "/nonexistent"
+        pub._poll_cache = {}
+        pub._latest_checkpoint = lambda d, cache=None: None
+        pub._m_polls = MetricRegistry().counter("polls", "poll count")
+        return pub
+
+    def test_snapshot_accessors_return_copies(self):
+        pub = self._bare_pub()
+        pub.history.append("a")
+        snap = pub.history_snapshot()
+        assert snap == ["a"]
+        snap.append("b")                 # mutating the copy is safe
+        assert list(pub.history) == ["a"]
+        assert pub.serving.version == "v1"
+
+    def test_poll_thread_writes_vs_health_reads(self):
+        pub = self._bare_pub()
+        stop = threading.Event()
+        errs = []
+
+        def poll_thread():
+            # the real poll path (_last_poll) plus the locked
+            # current/history swaps publish()/_roll_fleet now do
+            try:
+                while not stop.is_set():
+                    pub.poll_once()
+                    with pub._mu:
+                        pub.history.append(object())
+                        pub.current = SimpleNamespace(version="v2",
+                                                      neval=2)
+            except Exception as e:        # surfaced by the assert
+                errs.append(e)
+
+        t = threading.Thread(target=poll_thread, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                ok, msg = pub._alive()
+                assert ok and "serving v" in msg
+                pub.history_snapshot()
+                assert pub.serving.neval in (1, 2)
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert not errs and not t.is_alive()
+        assert pub._last_poll > 0.0
+
+
+# ---------------------------------------------------------------------------
 # canary qualification + live-traffic shadowing
 
 class TestCanaryAndShadow:
@@ -703,12 +772,14 @@ class TestEndToEndDrill:
             write_model_checkpoint(ck, model2, neval=2)
             deadline = _time.monotonic() + 120
             while (_time.monotonic() < deadline
-                   and not any(r.outcome == "ok" for r in pub.history)):
+                   and not any(r.outcome == "ok"
+                               for r in pub.history_snapshot())):
                 _time.sleep(0.05)
             stop.set()
             t.join(10)
             router.wait_all(timeout=120)
-            report = [r for r in pub.history if r.outcome == "ok"][-1]
+            report = [r for r in pub.history_snapshot()
+                      if r.outcome == "ok"][-1]
             assert report.canary.compiles == 0
             assert sorted(report.rolled) == ["r0", "r1"]
             assert {pool[n].weight_version

@@ -1,11 +1,14 @@
 """Repo self-check: ``dev/lint.py`` (classic rules + every jaxlint JX
-rule) runs clean over the whole tree against the committed baseline.
+rule + every raceguard TS rule) runs clean over the whole tree against
+the committed baseline.
 
 This is the gate that keeps TPU footguns (hidden host syncs, PRNG key
 reuse, use-after-donation, axis-name drift, host-only-package jax
-imports) from re-entering the codebase: a new finding either gets
-fixed, suppressed inline with a reason, or consciously added to
-``dev/analysis/baseline.txt`` in review."""
+imports) and concurrency bugs (lock-order inversions, blocking calls
+under a lock, unguarded thread-shared state — tests/test_raceguard.py
+covers the TS rules themselves) from re-entering the codebase: a new
+finding either gets fixed, suppressed inline with a reason, or
+consciously added to ``dev/analysis/baseline.txt`` in review."""
 import importlib.util
 import os
 import sys

@@ -1,0 +1,638 @@
+"""raceguard (dev/analysis/raceguard.py) — concurrency rules TS1-TS5.
+
+Per-rule fire/clean fixture pairs (each rule fires on a minimal
+snippet and stays silent on the shipped-code pattern), suppression +
+baseline plumbing, the declared lock-order contract checked against
+the REAL serving/deploy sources, and the repo self-check: the entire
+TS scan scope is clean with an empty baseline.
+
+All pure-AST: no threads are started and no jax is imported by the
+analyzer, so every test here is milliseconds.
+"""
+import os
+import sys
+import textwrap
+
+import pytest
+
+_DEV = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "dev")
+if _DEV not in sys.path:
+    sys.path.insert(0, _DEV)
+
+from analysis import jaxlint, raceguard  # noqa: E402
+
+REPO = os.path.dirname(_DEV)
+LIB = "bigdl_tpu/serving/fixture.py"
+
+
+def lint(src, rel=LIB):
+    return raceguard.analyze_source(textwrap.dedent(src), rel)
+
+
+def lint_many(*pairs):
+    """Analyze several (src, rel) files as one program (the lock
+    graph and order declarations are global)."""
+    infos = [raceguard._FileInfo(textwrap.dedent(s), r)
+             for s, r in pairs]
+    return raceguard._analyze(infos)
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------- TS1
+
+class TestTS1LockOrder:
+    def test_declared_order_violation_fires(self):
+        fs = lint('''
+            # raceguard: order inner < outer
+            import threading
+            class C:
+                def __init__(self):
+                    self._inner = threading.Lock()
+                    self._outer = threading.Lock()
+                def bad(self):
+                    with self._inner:
+                        with self._outer:
+                            pass
+            ''')
+        assert rules(fs) == ["TS1"]
+        assert "inner < outer" in fs[0].msg
+
+    def test_sanctioned_direction_is_clean(self):
+        # outer-then-inner is the declared nesting: no finding, and
+        # no cycle either (the declaration itself is not an edge)
+        fs = lint('''
+            # raceguard: order inner < outer
+            import threading
+            class C:
+                def __init__(self):
+                    self._inner = threading.Lock()
+                    self._outer = threading.Lock()
+                def good(self):
+                    with self._outer:
+                        with self._inner:
+                            pass
+            ''')
+        assert fs == []
+
+    def test_cycle_fires_without_declarations(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            ''')
+        assert rules(fs) == ["TS1", "TS1"]
+        assert all("cycle" in f.msg for f in fs)
+
+    def test_cross_class_call_edge_resolves_by_hint(self):
+        # the PR 6 shape: state lock held while calling into a
+        # replica method that takes the replica's (generic-named,
+        # class-qualified) lock
+        fs = lint('''
+            # raceguard: order state_lock < replica.lock
+            import threading
+            class Replica:
+                def __init__(self):
+                    self.lock = threading.RLock()
+                def submit(self, r):
+                    with self.lock:
+                        pass
+            class Router:
+                def __init__(self):
+                    self._state_lock = threading.Lock()
+                def bad(self, rep):
+                    with self._state_lock:
+                        rep.submit(None)
+            ''')
+        assert rules(fs) == ["TS1"]
+        assert "via Replica.submit()" in fs[0].msg
+
+    def test_unmatched_receiver_hint_makes_no_edge(self):
+        # dict.pop / unknown receivers never resolve to a scanned
+        # class: no guessed edges, no false TS1
+        fs = lint('''
+            # raceguard: order state_lock < replica.lock
+            import threading
+            class Replica:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                def submit(self):
+                    with self.lock:
+                        pass
+            class Router:
+                def __init__(self):
+                    self._state_lock = threading.Lock()
+                    self._pending = {}
+                def fine(self, rid):
+                    with self._state_lock:
+                        self._pending.pop(rid, None)
+            ''')
+        assert fs == []
+
+    def test_nonreentrant_reacquire_fires_rlock_exempt(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._m = threading.Lock()
+                    self._r = threading.RLock()
+                def bad(self):
+                    with self._m:
+                        with self._m:
+                            pass
+                def fine(self):
+                    with self._r:
+                        with self._r:
+                            pass
+            ''')
+        assert rules(fs) == ["TS1"]
+        assert "self-deadlock" in fs[0].msg
+
+    def test_bare_acquire_sites_count(self):
+        fs = lint('''
+            # raceguard: order a < b
+            import threading
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def bad(self):
+                    self._a.acquire()
+                    self._b.acquire()
+                    self._b.release()
+                    self._a.release()
+            ''')
+        assert rules(fs) == ["TS1"]
+
+
+class TestTS1RepoContract:
+    """The acceptance criterion: PR 6's state-lock/replica-lock order
+    is DECLARED in the real sources and actually enforced."""
+
+    def test_real_sources_declare_the_order(self):
+        for rel in ("bigdl_tpu/serving/router.py",
+                    "bigdl_tpu/serving/replica_pool.py",
+                    "bigdl_tpu/deploy/publisher.py"):
+            info = raceguard._FileInfo(_read(rel), rel)
+            pairs = [(a, b) for names, _ in info.orders
+                     for a in names for b in names
+                     if names.index(a) < names.index(b)]
+            assert ("state_lock", "replica.lock") in pairs, rel
+
+    def test_real_replica_lock_enforces_declared_order(self):
+        # a hypothetical router-side method that calls the REAL
+        # Replica.submit while holding a state lock must trip the
+        # REAL annotation in replica_pool.py — proving the declared
+        # contract is machine-checked, not just documented
+        bad = '''
+            import threading
+            class BadRouter:
+                def __init__(self):
+                    self._state_lock = threading.Lock()
+                def probe(self, rep):
+                    with self._state_lock:
+                        rep.submit(None)
+            '''
+        fs = lint_many(
+            (_read("bigdl_tpu/serving/replica_pool.py"),
+             "bigdl_tpu/serving/replica_pool.py"),
+            (bad, "bigdl_tpu/serving/badrouter.py"))
+        ts1 = [f for f in fs if f.rule == "TS1"]
+        assert len(ts1) == 1
+        assert ts1[0].path == "bigdl_tpu/serving/badrouter.py"
+        assert "replica.lock" in ts1[0].msg
+
+
+# ---------------------------------------------------------------- TS2
+
+class TestTS2BlockingUnderLock:
+    def test_sleep_under_lock_fires(self):
+        fs = lint('''
+            import threading, time
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    with self._lock:
+                        time.sleep(0.1)
+            ''')
+        assert rules(fs) == ["TS2"]
+
+    def test_sleep_after_release_is_clean(self):
+        # the shipped wait_idle/wait_all shape: check state under the
+        # lock, park OUTSIDE it
+        fs = lint('''
+            import threading, time
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def wait_idle(self):
+                    while True:
+                        with self._lock:
+                            done = True
+                        if done:
+                            return
+                        time.sleep(0.01)
+            ''')
+        assert fs == []
+
+    def test_queue_get_under_lock_fires_nowait_clean(self):
+        fs = lint('''
+            import threading, queue
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def bad(self):
+                    with self._lock:
+                        return self._q.get()
+                def fine(self):
+                    with self._lock:
+                        return self._q.get_nowait()
+            ''')
+        assert rules(fs) == ["TS2"]
+        assert "queue.get" in fs[0].msg
+
+    def test_transitive_same_class_call_fires(self):
+        # the drain/stop pin (satellite): holding the replica-style
+        # lock across a same-class wait helper is caught through the
+        # call, not just at the sleep site
+        fs = lint('''
+            import threading, time
+            class Rep:
+                def __init__(self):
+                    self.lock = threading.RLock()
+                def wait_idle(self):
+                    time.sleep(0.05)
+                def bad_stop(self):
+                    with self.lock:
+                        self.wait_idle()
+                def good_stop(self):
+                    self.wait_idle()
+                    with self.lock:
+                        pass
+            ''')
+        assert rules(fs) == ["TS2"]
+        assert "wait_idle()" in fs[0].msg
+
+    def test_thread_join_under_lock_fires(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                def _run(self):
+                    pass
+                def bad(self):
+                    with self._lock:
+                        self._t.join(1.0)
+            ''')
+        assert rules(fs) == ["TS2"]
+        assert "Thread.join" in fs[0].msg
+
+    def test_wait_for_on_held_condition_is_clean(self):
+        # Condition.wait/wait_for releases the held lock while
+        # parked — the CheckpointWriter.barrier shape is sanctioned
+        fs = lint('''
+            import threading
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._pending = 0
+                def barrier(self, timeout=None):
+                    with self._cond:
+                        self._cond.wait_for(
+                            lambda: self._pending == 0,
+                            timeout=timeout)
+            ''')
+        assert fs == []
+
+
+# ---------------------------------------------------------------- TS3
+
+class TestTS3UnguardedSharedWrites:
+    def test_private_attr_with_nonthread_reader_fires(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._n = 0
+                def _run(self):
+                    self._n += 1
+                def stats(self):
+                    return self._n
+            ''')
+        assert rules(fs) == ["TS3"]
+        assert "'_n'" in fs[0].msg
+
+    def test_public_attr_fires_even_without_local_reader(self):
+        # the publisher-history regression shape: a public deque
+        # appended on the poll thread is external API surface
+        fs = lint('''
+            import threading
+            from collections import deque
+            class P:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self.history = deque(maxlen=64)
+                def _run(self):
+                    self.history.append(1)
+            ''')
+        assert rules(fs) == ["TS3"]
+        assert "public" in fs[0].msg
+
+    def test_write_under_lock_is_clean(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._mu = threading.Lock()
+                    self._n = 0
+                def _run(self):
+                    with self._mu:
+                        self._n += 1
+                def stats(self):
+                    with self._mu:
+                        return self._n
+            ''')
+        assert fs == []
+
+    def test_thread_private_attr_is_clean(self):
+        # written and read only on the thread (plus __init__): no
+        # sharing, no finding
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._steps = 0
+                def _run(self):
+                    self._steps += 1
+            ''')
+        assert fs == []
+
+    def test_reachability_through_unlocked_self_calls(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._log = []
+                def _run(self):
+                    self._work()
+                def _work(self):
+                    self._log.append("x")
+                def dump(self):
+                    return list(self._log)
+            ''')
+        assert rules(fs) == ["TS3"]
+
+
+# ---------------------------------------------------------------- TS4
+
+class TestTS4ThreadLifecycle:
+    def test_non_daemon_thread_fires(self):
+        fs = lint('''
+            import threading
+            class C:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                def _run(self):
+                    pass
+            ''')
+        assert rules(fs) == ["TS4"]
+
+    def test_daemon_kwarg_and_daemon_attr_are_clean(self):
+        fs = lint('''
+            import threading
+            class C:
+                def a(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                def b(self):
+                    t = threading.Thread(target=self._run)
+                    t.daemon = True
+                    t.start()
+                def _run(self):
+                    pass
+            ''')
+        assert fs == []
+
+    def test_teardown_join_without_timeout_fires(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                def _run(self):
+                    pass
+                def close(self):
+                    self._t.join()
+            ''')
+        assert rules(fs) == ["TS4"]
+        assert "close()" in fs[0].msg
+
+    def test_join_with_timeout_and_non_teardown_join_clean(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                def _run(self):
+                    pass
+                def close(self, timeout=5.0):
+                    self._t.join(timeout)
+                def barrier(self):
+                    self._t.join()
+            ''')
+        assert fs == []
+
+
+# ---------------------------------------------------------------- TS5
+
+class TestTS5ConditionWait:
+    def test_wait_outside_while_fires(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                def bad(self):
+                    with self._cond:
+                        self._cond.wait()
+            ''')
+        assert rules(fs) == ["TS5"]
+
+    def test_wait_inside_while_predicate_is_clean(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._done = False
+                def good(self):
+                    with self._cond:
+                        while not self._done:
+                            self._cond.wait()
+            ''')
+        assert fs == []
+
+    def test_wait_for_is_clean(self):
+        fs = lint('''
+            import threading
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._done = False
+                def good(self):
+                    with self._cond:
+                        self._cond.wait_for(lambda: self._done)
+            ''')
+        assert fs == []
+
+
+# ----------------------------------------------- suppression/baseline
+
+class TestSuppressionAndBaseline:
+    BAD_TS2 = '''
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)  # jaxlint: disable=TS2
+        '''
+
+    def test_disable_comment_suppresses_named_rule(self):
+        assert lint(self.BAD_TS2) == []
+
+    def test_disable_other_rule_does_not_suppress(self):
+        src = self.BAD_TS2.replace("disable=TS2", "disable=TS5")
+        assert rules(lint(src)) == ["TS2"]
+
+    def test_blanket_disable_suppresses(self):
+        src = self.BAD_TS2.replace("disable=TS2", "disable")
+        assert lint(src) == []
+
+    def test_baseline_fingerprints_filter_and_prune(self):
+        src = self.BAD_TS2.replace("  # jaxlint: disable=TS2", "")
+        fs = lint(src)
+        assert rules(fs) == ["TS2"]
+        entries = [tuple(jaxlint.format_baseline_entry(f).split(":", 2))
+                   for f in fs]
+        new, stale = jaxlint.apply_baseline(fs, entries)
+        assert new == [] and stale == []
+        # a stale entry (finding gone) surfaces for pruning
+        gone = (LIB, "TS2", "time.sleep(9)")
+        new, stale = jaxlint.apply_baseline(fs, entries + [gone])
+        assert new == [] and stale == [gone]
+
+
+# --------------------------------------------------- repo self-check
+
+def _scan_paths():
+    paths = []
+    for root, _, names in os.walk(os.path.join(REPO, "bigdl_tpu")):
+        paths += [os.path.join(root, n) for n in sorted(names)
+                  if n.endswith(".py")]
+    sdir = os.path.join(REPO, "scripts")
+    if os.path.isdir(sdir):
+        paths += [os.path.join(sdir, n) for n in sorted(os.listdir(sdir))
+                  if n.endswith(".py")]
+    return paths
+
+
+class TestRepoSelfCheck:
+    def test_threaded_host_plane_is_clean(self):
+        # the shipped tree carries ZERO non-baselined TS findings —
+        # and the baseline ships empty, so zero findings period
+        fs = raceguard.analyze_files(_scan_paths(), REPO)
+        assert fs == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.msg}" for f in fs)
+
+    def test_scan_scope_prefix_filter(self, tmp_path):
+        bad = textwrap.dedent('''
+            import threading
+            class C:
+                def start(self):
+                    t = threading.Thread(target=run)
+                    t.start()
+            def run():
+                pass
+            ''')
+        inside = tmp_path / "bigdl_tpu" / "serving" / "x.py"
+        outside = tmp_path / "bigdl_tpu" / "optim" / "y.py"
+        for p in (inside, outside):
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(bad)
+        fs = raceguard.analyze_files([str(inside), str(outside)],
+                                     str(tmp_path))
+        assert [f.path for f in fs] == ["bigdl_tpu/serving/x.py"]
+        assert rules(fs) == ["TS4"]
+
+
+# --------------------------------------------------- lint.py driver
+
+def _load_lint():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "dev_lint_rg", os.path.join(REPO, "dev", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestLintDriver:
+    def test_rules_flag_ts_only_passes_repo(self, capsys):
+        lint_mod = _load_lint()
+        rc = lint_mod.main(["--rules", "TS"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 finding(s)" in out
+
+    def test_rules_flag_rejects_unknown_family(self):
+        lint_mod = _load_lint()
+        with pytest.raises(SystemExit):
+            lint_mod.main(["--rules", "XX"])
+
+    def test_stale_detection_is_family_scoped(self, monkeypatch):
+        # a JX baseline entry must not be reported stale by a
+        # TS-only run (and vice versa it must be by a JX run)
+        lint_mod = _load_lint()
+        entry = ("bigdl_tpu/zz.py", "JX1", "ghost()")
+        monkeypatch.setattr(lint_mod.jaxlint, "load_baseline",
+                            lambda path=None: [entry])
+        out, _ = lint_mod.run_jaxlint([], rules=("TS",))
+        assert out == []
+        out, _ = lint_mod.run_jaxlint([], rules=("JX",))
+        assert len(out) == 1 and "stale" in out[0][2]
