@@ -1481,6 +1481,135 @@ def bench_serving_tokens_per_sec(**kw):
     }
 
 
+def _bench_prefix_reuse_run(*, n_requests: int = 10, max_new: int = 8,
+                            d_model: int = 256, num_layers: int = 4):
+    """Shared-system-prompt workload through a 1-replica router, run
+    twice: longest-prefix reuse ON vs exact-only matching. Every
+    prompt is a common 3-page (48-token) prefix plus a distinct
+    16-token suffix, so exact matching gets ZERO reuse while the radix
+    index adopts the 3 shared pages and prefills only the suffix.
+    Requests are submitted sequentially with the TTFT histogram's
+    ``sum`` read around each one, so per-request TTFTs are exact (not
+    bucket-upper-bound) and the p50/p99 comparison is meaningful at
+    sub-bucket resolution. Compiles are paid by a warmup batcher
+    (module-level jit caches) before either mode runs."""
+    import jax
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+    from bigdl_tpu.observability.exporter import HealthRegistry
+    from bigdl_tpu.observability.registry import MetricRegistry
+    from bigdl_tpu.serving import (PrefixCache, ReplicaPool, Router,
+                                   SLOConfig)
+
+    _set_bf16_policy()
+    vocab, page = 8192, 16
+    model = TransformerLM(vocab, d_model=d_model, num_heads=4,
+                          num_layers=num_layers, max_len=320,
+                          with_log_softmax=False, num_kv_heads=1)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    host = np.random.default_rng(7)
+    shared = list(host.integers(1, vocab + 1, size=(3 * page,)))
+    prompts = [shared + list(host.integers(1, vocab + 1, size=(page,)))
+               for _ in range(n_requests + 1)]   # +1 seed
+    geo = dict(max_batch=4, num_pages=96, page_size=page,
+               max_new_tokens=max_new, max_burst=8)
+    # warmup: pay the full-prefill (bucket 64), suffix-prefill
+    # (bucket 16 at start 48), adopt and decode compiles once
+    warm = ContinuousBatcher(model, registry=MetricRegistry(),
+                             health=HealthRegistry(), **geo)
+    warm.submit("wf", prompts[0])
+    warm.run_to_completion()
+    wsnap = warm.prefill_only("wp", prompts[0]).truncate(3 * page)
+    warm.submit("ws", prompts[1], snapshot=wsnap,
+                prefill_from=3 * page)
+    warm.run_to_completion()
+    warm.submit("wa", snapshot=warm.prefill_only("wq", prompts[0]))
+    warm.run_to_completion()
+
+    out = {}
+    for mode in ("reuse", "exact"):
+        health = HealthRegistry()
+        reg = MetricRegistry()
+        pool = ReplicaPool(model, 1, health=health, **geo)
+        router = Router(
+            pool, slo=SLOConfig(long_prefill_tokens=10_000),
+            prefix_cache=PrefixCache(min_tokens=page, page_size=page,
+                                     longest_match=(mode == "reuse"),
+                                     registry=reg),
+            registry=reg, health=health)
+        try:
+            router.submit("seed", prompts[0])
+            router.wait_all(timeout=300)
+            router.finished()
+
+            def _ttft_sum():
+                return sum(
+                    r.histogram_snapshot("serving_ttft_seconds")["sum"]
+                    for r in pool)
+
+            partial0 = reg.get(
+                "router_prefix_partial_hits_total").value()
+            reused0 = reg.get(
+                "router_prefix_tokens_reused_total").value()
+            tokens0 = reg.get("router_prompt_tokens_total").value()
+            ttfts, firsts = [], []
+            for i in range(1, n_requests + 1):
+                s0 = _ttft_sum()
+                router.submit(i, prompts[i])
+                router.wait_all(timeout=300)
+                ttfts.append(_ttft_sum() - s0)
+                firsts.append(int(dict(router.finished())[i][0]))
+            out[mode] = {
+                "ttft_p50_s": float(np.percentile(ttfts, 50)),
+                "ttft_p99_s": float(np.percentile(ttfts, 99)),
+                "firsts": firsts,
+                "partial_hits": int(reg.get(
+                    "router_prefix_partial_hits_total").value()
+                    - partial0),
+                "tokens_reused_fraction": float(
+                    (reg.get("router_prefix_tokens_reused_total")
+                     .value() - reused0)
+                    / max(1.0, reg.get("router_prompt_tokens_total")
+                          .value() - tokens0)),
+            }
+        finally:
+            router.close()
+            pool.close()
+    return out, prompts, geo
+
+
+def bench_prefix_reuse_ttft(**kw):
+    """TTFT win from fleet-global longest-prefix KV reuse on the
+    shared-system-prompt workload (ISSUE 18): ``value`` is the
+    reuse-ON p50; the exact-only baseline p50/p99, the measured
+    tokens-reused fraction and first-token parity ride as fields."""
+    out, prompts, geo = _bench_prefix_reuse_run(**kw)
+    reuse, exact = out["reuse"], out["exact"]
+    params = _fmt_params(kw.get("d_model", 256), kw.get("num_layers", 4))
+    return {
+        "metric": "prefix_reuse_ttft",
+        "value": round(reuse["ttft_p50_s"], 5),
+        "unit": "seconds",
+        "ttft_p50_s": round(reuse["ttft_p50_s"], 5),
+        "ttft_p99_s": round(reuse["ttft_p99_s"], 5),
+        "exact_ttft_p50_s": round(exact["ttft_p50_s"], 5),
+        "exact_ttft_p99_s": round(exact["ttft_p99_s"], 5),
+        "speedup_p50": round(exact["ttft_p50_s"]
+                             / max(reuse["ttft_p50_s"], 1e-9), 2),
+        "partial_hits": reuse["partial_hits"],
+        "tokens_reused_fraction": round(
+            reuse["tokens_reused_fraction"], 4),
+        "first_tokens_match": bool(reuse["firsts"] == exact["firsts"]),
+        "n_requests": len(prompts) - 1,
+        "geometry": (f"{params} MQA 1x"
+                     f"({geo['max_batch']} slots, {geo['num_pages']} "
+                     f"pages x {geo['page_size']}) 48-token shared "
+                     f"prefix + 16-token suffixes"),
+    }
+
+
 def bench_serving_decode_hbm(**geometry):
     """Static per-decode-step HBM accounting, dense view vs the Pallas
     paged kernel (ISSUE 9 — the tentpole's measured receipt): lowers
@@ -1859,7 +1988,8 @@ GATE_DEFAULT_MIN_RATIO = 0.8
 _GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
                          "collective_wire_bytes_per_step",
                          "autoscale_time_to_capacity",
-                         "publish_to_fleet_secs"}
+                         "publish_to_fleet_secs",
+                         "prefix_reuse_ttft"}
 
 GATE_EXIT_CODE = 4
 
@@ -2198,7 +2328,8 @@ def _run(args):
                 "compile_cold_start", "serving_decode_hbm_bytes",
                 "train_peak_hbm_bytes", "multichip_scaling",
                 "pipeline_bubble_fraction", "elastic_resume_secs",
-                "autoscale_time_to_capacity", "publish_to_fleet_secs"]
+                "autoscale_time_to_capacity", "publish_to_fleet_secs",
+                "prefix_reuse_ttft"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -2208,7 +2339,7 @@ def _run(args):
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
              "multichip_scaling", "pipeline_bubble_fraction",
              "elastic_resume_secs", "autoscale_time_to_capacity",
-             "publish_to_fleet_secs"}
+             "publish_to_fleet_secs", "prefix_reuse_ttft"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -2264,6 +2395,7 @@ def _run(args):
         "elastic_resume_secs": bench_elastic_resume_secs,
         "autoscale_time_to_capacity": bench_autoscale_time_to_capacity,
         "publish_to_fleet_secs": bench_publish_to_fleet,
+        "prefix_reuse_ttft": bench_prefix_reuse_ttft,
     }
     rows_out: list[dict] = []
     headline_failed = False

@@ -49,10 +49,10 @@ from bigdl_tpu.observability.registry import default_registry
 from bigdl_tpu.tensor import activation_dtype, compute_dtype
 
 __all__ = ["generate_ragged", "PagedKVCache", "paged_prefill",
-           "paged_decode", "paged_decode_step_stats",
-           "decode_hbm_probe", "speculative_generate",
-           "ContinuousBatcher", "KVSnapshot", "PAGED_KERNEL_ENV",
-           "PagedStepCompilers"]
+           "paged_suffix_prefill", "paged_decode",
+           "paged_decode_step_stats", "decode_hbm_probe",
+           "speculative_generate", "ContinuousBatcher", "KVSnapshot",
+           "PAGED_KERNEL_ENV", "PagedStepCompilers"]
 
 
 def _rope_rows(x, positions, theta: float = 10000.0):
@@ -490,6 +490,145 @@ def paged_prefill(model, cache: PagedKVCache, table, prompts, *,
         first, kp, vp = _paged_prefill_impl(
             params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
             jnp.asarray(batch), jnp.asarray(lengths), **statics)
+    cache.kp, cache.vp = kp, vp
+    return first, lengths
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=(
+    "num_layers", "num_heads", "page_size", "policy_key", "rope",
+    "num_kv_heads", "paged_kernel"))
+def _paged_suffix_prefill_impl(params, kp, vp, table, suffix, start,
+                               lengths, *, num_layers, num_heads,
+                               page_size, policy_key, rope=False,
+                               num_kv_heads=None, paged_kernel="dense"):
+    """Prefill only the SUFFIX of each row: column j of ``suffix``
+    (B, Smax) sits at absolute position ``start[i] + j`` — the first
+    ``start[i]`` tokens are already cached in the pages ``table`` maps
+    (an adopted prefix snapshot). Writes scatter to the page/slot of
+    the absolute position; attention runs with per-row ``q_start`` so
+    each query column attends every cached prefix key plus the suffix
+    keys at/before its own position — exactly what the full prefill
+    computed for those columns, which is what makes adopt-prefix +
+    prefill-suffix bitwise-equivalent to prefilling the whole prompt
+    (causality: the KV of token j depends on tokens <= j only).
+    ``lengths`` (B,) are ABSOLUTE total prompt lengths; padding columns
+    (start + j >= lengths) scatter out-of-range and are dropped.
+    Returns (greedy first token (B,), kp, vp)."""
+    embed, blocks, _, _ = _model_parts(params, num_layers)
+    dtype = activation_dtype()
+    b, smax = suffix.shape
+    num_pages = kp[0].shape[0]
+    # absolute position of every suffix column, per row
+    cols = start[:, None] + jnp.broadcast_to(jnp.arange(smax)[None, :],
+                                             (b, smax))
+    x = _embed_rows(embed, suffix, cols).astype(dtype)
+    valid = cols < lengths[:, None]
+    # clamp the table gather for padding columns past the row's page
+    # allocation; their writes are dropped via the OOB page id anyway
+    log_page = table[jnp.arange(b)[:, None],
+                     jnp.minimum(cols // page_size,
+                                 table.shape[1] - 1)]
+    phys = jnp.where(valid, log_page, num_pages)     # OOB -> drop
+    slot = cols % page_size
+    new_kp, new_vp = list(kp), list(vp)
+    scale = (x.shape[-1] // num_heads) ** -0.5
+    for li in range(num_layers):
+        q, k, v = _qkv(blocks[li], x, num_heads, num_kv_heads)
+        if rope:
+            q = _rope_rows(q, cols)
+            k = _rope_rows(k, cols)
+        new_kp[li] = new_kp[li].at[phys, slot].set(
+            k.astype(kp[li].dtype), mode="drop")
+        new_vp[li] = new_vp[li].at[phys, slot].set(
+            v.astype(vp[li].dtype), mode="drop")
+        # the kernel's per-row q_start IS the suffix offset; the dense
+        # path masks to absolute key positions <= cols per query column
+        o = _attend_paged(q, new_kp[li], new_vp[li], table, start,
+                          cols, num_heads, scale, paged_kernel)
+        o = o.reshape(x.shape).astype(x.dtype)
+        x = x + _proj(blocks[li]["0"]["1"], "out",
+                      o).astype(activation_dtype())
+        x = x + _ffn(blocks[li]["1"]["1"], _ln(blocks[li]["1"]["0"], x))
+    logits = _row_logits(params, num_layers, x, lengths - start - 1)
+    first = jnp.argmax(logits.astype(jnp.float32), axis=-1) + 1
+    return first, tuple(new_kp), tuple(new_vp)
+
+
+def paged_suffix_prefill(model, cache: PagedKVCache, table, suffixes, *,
+                         start, lengths, params=None, paged_kernel=None,
+                         compilers: "PagedStepCompilers | None" = None,
+                         warm_only: bool = False):
+    """Prefill only the suffix of each row into the paged pool — the
+    prefix-reuse fast path: the caller has already scattered a
+    prefix-clean :class:`KVSnapshot`'s pages into ``table``'s rows and
+    runs prefill for tokens ``start..lengths`` only.
+
+    ``suffixes``: list of 1-based id sequences (row i holds tokens
+    ``start[i]..lengths[i]`` of its prompt) — or, with 2-D input, an
+    already right-padded (B, Smax) array. ``start`` (B,): tokens
+    already cached per row (page-aligned on the batcher path);
+    ``lengths`` (B,): ABSOLUTE total prompt lengths. Returns (greedy
+    first tokens (B,), lengths (B,)) exactly like :func:`paged_prefill`
+    — and BITWISE the same tokens full prefill would have produced,
+    on the dense and kernel paths alike (test-pinned)."""
+    params = model.params if params is None else params
+    meta = model.lm_meta
+    start = np.asarray(start, np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    batch = np.asarray(suffixes, np.int32) \
+        if not isinstance(suffixes, (list, tuple)) else None
+    if batch is None:
+        smax = max(len(s) for s in suffixes)
+        batch = np.ones((len(suffixes), smax), np.int32)
+        for i, s in enumerate(suffixes):
+            batch[i, :len(s)] = np.asarray(s, np.int32)
+    if batch.ndim != 2 or start.shape != (batch.shape[0],) \
+            or lengths.shape != (batch.shape[0],):
+        raise ValueError("suffix prefill needs a (B, Smax) array with "
+                         "(B,) start and lengths")
+    if bool(np.any(lengths - start < 1)):
+        raise ValueError(f"empty suffix: start {start.tolist()} must "
+                         f"leave >= 1 token of lengths "
+                         f"{lengths.tolist()} to prefill")
+    if bool(np.any(lengths - start > batch.shape[1])):
+        raise ValueError(f"suffixes of {(lengths - start).tolist()} "
+                         f"tokens exceed the padded width "
+                         f"{batch.shape[1]}")
+    table = np.asarray(table, np.int32)
+    capacity = table.shape[1] * cache.page_size
+    if int(lengths.max()) > capacity:
+        raise ValueError(
+            f"prompt of {int(lengths.max())} tokens exceeds the table's "
+            f"{table.shape[1]} pages x {cache.page_size} slots "
+            f"= {capacity}-token capacity")
+    policy_key = (str(activation_dtype()), str(compute_dtype()))
+    kernel = _resolve_paged_kernel(
+        paged_kernel, lambda: _pool_kernel_supported(cache))
+    statics = dict(
+        num_layers=meta["num_layers"], num_heads=meta["num_heads"],
+        page_size=cache.page_size, policy_key=policy_key,
+        rope=meta.get("pos_encoding", "learned") == "rope",
+        num_kv_heads=meta.get("num_kv_heads"), paged_kernel=kernel)
+    if compilers is not None:
+        args = (params, cache.kp, cache.vp,
+                jnp.asarray(table, jnp.int32), jnp.asarray(batch),
+                jnp.asarray(start), jnp.asarray(lengths))
+        quick = ("suffix_prefill", batch.shape, np.asarray(table).shape)
+        if warm_only:
+            compilers.prepare("serving_suffix_prefill_step",
+                              _paged_suffix_prefill_impl, (1, 2),
+                              statics, quick, args)
+            return None
+        first, kp, vp = compilers.run(
+            "serving_suffix_prefill_step", _paged_suffix_prefill_impl,
+            (1, 2), statics, quick, args)
+    elif warm_only:
+        raise ValueError("warm_only suffix prefill needs compilers=")
+    else:
+        first, kp, vp = _paged_suffix_prefill_impl(
+            params, cache.kp, cache.vp, jnp.asarray(table, jnp.int32),
+            jnp.asarray(batch), jnp.asarray(start),
+            jnp.asarray(lengths), **statics)
     cache.kp, cache.vp = kp, vp
     return first, lengths
 
@@ -1178,11 +1317,58 @@ class KVSnapshot:
     def nbytes(self) -> int:
         return sum(int(k.nbytes) + int(v.nbytes) for k, v in self.kv)
 
+    @property
+    def is_prefix_only(self) -> bool:
+        """True for a truncated prefix snapshot: it carries cached KV
+        pages but no sampled token, so it can only enter a batcher
+        through ``submit(..., snapshot=, prefill_from=)`` — the suffix
+        prefill produces the first token."""
+        return not self.emitted
+
+    def truncate(self, n_tokens: int) -> "KVSnapshot":
+        """A page-boundary prefix of this snapshot: keep the full pages
+        covering at most ``n_tokens`` PROMPT tokens (the partial page is
+        dropped — its slots would mix in tokens past the boundary) and
+        return a new prefix-only snapshot whose ``prompt``/``n_cached``/
+        page list are mutually consistent. Causality makes the kept
+        pages exact: the KV of token j is a function of tokens <= j
+        only, so the prefix pages of a longer prefill ARE the prefill
+        of the prefix. Raises ``ValueError`` when no full page fits."""
+        limit = min(int(n_tokens), self.n_cached, len(self.prompt))
+        p = (limit // self.page_size) * self.page_size
+        if p <= 0:
+            raise ValueError(
+                f"cannot truncate to {n_tokens} tokens: no full "
+                f"{self.page_size}-slot page fits (n_cached="
+                f"{self.n_cached}, prompt_len={len(self.prompt)})")
+        n_pages = p // self.page_size
+        # real copies, not views: the point of truncation is that the
+        # retained entry's bytes actually shrink
+        kv = [(np.ascontiguousarray(k[:n_pages]),
+               np.ascontiguousarray(v[:n_pages])) for k, v in self.kv]
+        return KVSnapshot(self.prompt[:p], p, kv,
+                          last_token=self.prompt[p - 1], emitted=[],
+                          page_size=self.page_size,
+                          weight_version=self.weight_version)
+
     def __repr__(self):
         return (f"KVSnapshot(prompt_len={len(self.prompt)}, "
                 f"n_cached={self.n_cached}, n_pages={self.n_pages}, "
                 f"emitted={len(self.emitted)}, "
                 f"weight_version={self.weight_version!r})")
+
+
+class _SuffixJob:
+    """Queued adopt-prefix + prefill-suffix admission: the full prompt,
+    the page-aligned prefix snapshot to adopt, and the token offset the
+    suffix prefill starts at (``start == snapshot.n_cached``)."""
+
+    __slots__ = ("prompt", "snapshot", "start")
+
+    def __init__(self, prompt, snapshot, start):
+        self.prompt = list(prompt)
+        self.snapshot = snapshot
+        self.start = int(start)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -1338,6 +1524,10 @@ class ContinuousBatcher:
             "serving_prefill_skips_total",
             "admissions that adopted a KV snapshot instead of "
             "running prefill")
+        self._m_suffix = reg.counter(
+            "serving_suffix_prefills_total",
+            "admissions that adopted a prefix snapshot and prefilled "
+            "only the suffix (partial prefix-cache hits)")
         self._m_cancel = reg.counter(
             "serving_cancelled_total",
             "requests cancelled before completion (queued or in-flight)")
@@ -1351,6 +1541,9 @@ class ContinuousBatcher:
         self._prefill_fn = self._watch.watch(
             lambda *a, **k: paged_prefill(*a, **k),
             name="serving_prefill")
+        self._suffix_fn = self._watch.watch(
+            lambda *a, **k: paged_suffix_prefill(*a, **k),
+            name="serving_suffix_prefill")
         self._decode_fn = self._watch.watch(
             lambda *a, **k: paged_decode(*a, **k),
             name="serving_decode")
@@ -1462,20 +1655,58 @@ class ContinuousBatcher:
         self.weight_version = weight_version
 
     def submit(self, request_id, prompt=None, *,
-               snapshot: KVSnapshot | None = None) -> None:
+               snapshot: KVSnapshot | None = None,
+               prefill_from: int | None = None) -> None:
         """Queue one request (list of 1-based token ids) — or, with
         ``snapshot=``, a :class:`KVSnapshot` to ADOPT: admission then
         allocates pages and scatters the cached KV back in instead of
         running prefill (prefix-cache hits, disaggregated prefills and
-        drain migration all enter here). Raises on a ``request_id``
-        still queued or in flight — the router's timeout/retry story
-        needs duplicate submission to be loud, not silently doubled."""
+        drain migration all enter here). With BOTH ``prompt`` and
+        ``snapshot`` plus ``prefill_from=p``, the snapshot is a
+        page-aligned PREFIX of the prompt (``KVSnapshot.truncate``):
+        admission adopts its pages and prefills only tokens ``p..n`` at
+        ``q_start=p`` — the partial prefix-cache hit. Raises on a
+        ``request_id`` still queued or in flight — the router's
+        timeout/retry story needs duplicate submission to be loud, not
+        silently doubled."""
         if request_id in self.request_ids():
             raise ValueError(f"duplicate request_id {request_id!r}: "
                              "still queued or in flight")
-        if snapshot is not None:
+        if prefill_from is not None:
+            if snapshot is None or prompt is None:
+                raise ValueError("prefill_from= needs BOTH the full "
+                                 "prompt and the prefix snapshot")
+            prompt = list(prompt)
+            p = int(prefill_from)
+            self._validate_snapshot(snapshot)
+            if p != snapshot.n_cached:
+                raise ValueError(
+                    f"prefill_from {p} != snapshot n_cached "
+                    f"{snapshot.n_cached} — truncate() the snapshot to "
+                    "the adopted boundary first")
+            if p <= 0 or p % self.page_size != 0:
+                raise ValueError(f"prefill_from {p} must be a positive "
+                                 f"multiple of page_size "
+                                 f"{self.page_size}")
+            if p >= len(prompt):
+                raise ValueError(
+                    f"prefill_from {p} leaves no suffix of the "
+                    f"{len(prompt)}-token prompt to prefill (an exact "
+                    "hit adopts the snapshot without prefill_from)")
+            if list(snapshot.prompt) != prompt[:p]:
+                raise ValueError(
+                    "snapshot prefix tokens differ from prompt[:"
+                    f"{p}] — adopting them would silently change the "
+                    "output")
+        elif snapshot is not None:
             if prompt is not None:
-                raise ValueError("pass prompt OR snapshot, not both")
+                raise ValueError("pass prompt OR snapshot, not both "
+                                 "(both only with prefill_from=)")
+            if snapshot.is_prefix_only:
+                raise ValueError(
+                    "prefix-only snapshot (no emitted token) needs "
+                    "prefill_from= and the full prompt — direct "
+                    "adoption has no first token to continue from")
             self._validate_snapshot(snapshot)
             prompt = snapshot.prompt
         elif prompt is None:
@@ -1490,7 +1721,12 @@ class ContinuousBatcher:
                 f"request needs {self._need_pages(len(prompt))} pages "
                 f"but the pool holds {self._pool_pages} — enlarge "
                 "num_pages or shorten the prompt/budget")
-        payload = snapshot if snapshot is not None else list(prompt)
+        if prefill_from is not None:
+            payload = _SuffixJob(prompt, snapshot, prefill_from)
+        elif snapshot is not None:
+            payload = snapshot
+        else:
+            payload = list(prompt)
         self.queue.append((request_id, payload, time.monotonic()))
         self._m_queue.set(len(self.queue))
 
@@ -1522,6 +1758,10 @@ class ContinuousBatcher:
             if isinstance(payload, KVSnapshot):
                 if not self._admit_snapshot(slot, rid, payload,
                                             t_submit):
+                    break                 # admit in arrival order only
+                continue
+            if isinstance(payload, _SuffixJob):
+                if not self._admit_suffix(slot, rid, payload, t_submit):
                     break                 # admit in arrival order only
                 continue
             prompt = payload
@@ -1608,6 +1848,59 @@ class ContinuousBatcher:
             self._retire(slot)        # migrated right at the finish line
         return True
 
+    def _admit_suffix(self, slot: int, rid, job: "_SuffixJob",
+                      t_submit) -> bool:
+        """Adopt a page-aligned prefix snapshot into ``slot`` and
+        prefill ONLY the suffix at ``q_start=job.start`` — the partial
+        prefix-cache hit. Pages cover the FULL prompt (the suffix
+        writes land past the adopted pages); the first token comes off
+        the suffix prefill's logits exactly where full prefill would
+        have read them."""
+        prompt, snap, p = job.prompt, job.snapshot, job.start
+        pages_needed = self._need_pages(len(prompt))
+        if pages_needed > self.cache.pages_free:
+            return False
+        self.queue.pop(0)
+        pages = self.cache.alloc(pages_needed * self.page_size)
+        self._pages[slot] = pages
+        row = np.full((self.pages_per_slot,), self._scratch, np.int32)
+        row[:len(pages)] = pages
+        self.table[slot] = row
+        suffix = prompt[p:]
+        bucket = min(self._bucket(len(suffix)), self.max_prompt)
+        padded = np.ones((1, bucket), np.int32)
+        padded[0, :len(suffix)] = suffix
+        with trace.span("suffix prefill", cat="serving", bucket=bucket,
+                        prompt_len=len(prompt), prefill_from=p,
+                        host_sync="first-token readback"):
+            self._adopt_kv(pages, snap)
+            first, _ = self._suffix_fn(
+                self.model, self.cache, row[None, :], padded,
+                start=np.asarray([p], np.int32),
+                lengths=np.asarray([len(prompt)], np.int32),
+                **self._kernel_kw)
+            # deliberate sync: TTFT is DEFINED by this readback
+            tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
+        self._m_ttft.observe(time.monotonic() - t_submit)
+        self._m_admit.inc()
+        self._m_suffix.inc()
+        self.slots[slot] = (rid, list(prompt), [tok0])
+        self.lengths[slot] = len(prompt)
+        self.last[slot] = tok0
+        if self.on_prefill is not None:
+            # the FULL prompt is now cached and prefix-clean: capture
+            # extends the fleet index to the longer prefix
+            try:
+                self.on_prefill(rid, list(prompt),
+                                functools.partial(self._export_slot,
+                                                  slot))
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "on_prefill hook failed for %r", rid)
+        if self.eos_id is not None and tok0 == self.eos_id:
+            self._retire(slot)
+        return True
+
     def _adopt_kv(self, pages, snap: KVSnapshot) -> None:
         idx = jnp.asarray(np.asarray(pages[:snap.n_pages], np.int32))
         kp, vp = list(self.cache.kp), list(self.cache.vp)
@@ -1670,8 +1963,12 @@ class ContinuousBatcher:
     def pop_queued(self) -> list:
         """Remove and return every still-QUEUED entry as
         ``[(request_id, prompt_or_snapshot), ...]`` — on drain the
-        router re-dispatches these to the surviving replicas."""
-        out = [(rid, payload) for rid, payload, _ in self.queue]
+        router re-dispatches these to the surviving replicas. A queued
+        suffix job unwraps to its FULL prompt: re-dispatch re-queries
+        the fleet prefix index, which recovers the reuse (or better)
+        on whichever replica admits it."""
+        out = [(rid, payload.prompt if isinstance(payload, _SuffixJob)
+                else payload) for rid, payload, _ in self.queue]
         self.queue = []
         self._m_queue.set(0)
         return out

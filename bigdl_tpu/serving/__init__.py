@@ -7,8 +7,10 @@ pipeline-to-serving story, arXiv:2204.01715). Six modules:
 
 - ``slo``           — :class:`SLOConfig` targets, :class:`ReplicaStats`,
   the admission predicate and histogram-percentile helpers.
-- ``prefix_cache``  — :class:`PrefixCache`, the token-prefix -> retained
-  KV snapshot index behind sticky routing and prefill skips.
+- ``prefix_cache``  — :class:`PrefixCache`, the radix longest-prefix ->
+  retained KV snapshot index (page-block granularity, optional int8
+  storage) behind sticky routing, prefill skips and suffix-only
+  prefills.
 - ``replica_pool``  — :class:`Replica` / :class:`ReplicaPool`, N batcher
   step loops on daemon driver threads with per-replica registries and
   health checks.

@@ -1,67 +1,193 @@
-"""Prefix/session KV-cache index: token prefix -> (replica, retained
-KV snapshot).
+"""Fleet-global prefix index: token radix trie -> (replica, retained
+KV snapshot), with longest-prefix matching at page granularity.
 
 Repeated system prompts are the serving workload's common case; without
 an index every resubmission re-pays the full prefill. The router
 captures a :class:`~bigdl_tpu.models.transformer.serving.KVSnapshot`
 right after a prompt's first prefill (the batcher's ``on_prefill`` hook
 fires before any decode write lands in the partial page, so the copy is
-prefix-clean) and stores it here keyed by the token sequence. A later
-request with the SAME prompt adopts the snapshot instead of prefilling
-— the measured "prefill skip" (``serving_prefill_skips_total`` on the
-adopting replica, ``router_prefix_hits_total`` at the router).
+prefix-clean) and stores it here keyed by the token sequence.
+
+Two lookup contracts:
+
+- ``lookup(prompt)`` — exact-equality, the original contract. A later
+  request with the SAME prompt adopts the snapshot instead of
+  prefilling (``serving_prefill_skips_total`` on the adopting replica,
+  ``router_prefix_hits_total`` at the router).
+- ``lookup_longest(prompt) -> (entry, matched_tokens)`` — the radix
+  walk. A request sharing >= 1 full KV page with a cached entry gets
+  that entry plus how many tokens matched; the router truncates the
+  snapshot to the page boundary (``KVSnapshot.truncate``) and prefills
+  only the suffix. Matching is PAGE-GRANULAR: the trie is keyed on
+  ``page_size``-token blocks, because a partial page cannot be adopted
+  (its tail slots would hold the wrong keys).
+
+Insertion dedups shared prefixes: a put whose prompt extends an
+existing entry supersedes it (the longer snapshot serves every lookup
+the shorter one served, via truncation), and a put already covered by a
+longer entry is skipped. ``store_int8=True`` keeps snapshots quantized
+(symmetric per-vector int8, the ``parameters/compression.py`` codec
+mirrored in numpy) — ~4x more prefixes per byte of budget — and
+dequantizes on adopt.
 
 Entries remember the replica that produced them only as a STICKY
 ROUTING PREFERENCE; the snapshot itself is a host-side copy, so a hit
 can be adopted by any identically configured replica — which is what
 lets prefix reuse survive a drain/rolling restart.
 
-Correctness: the key is the exact token tuple and ``lookup`` verifies
-it (dict hashing plus full equality), because adopting the wrong KV
-would silently change outputs. Eviction is LRU with both an entry and a
-byte budget (snapshots hold real page data).
+Correctness: exact lookup verifies the full token tuple (dict hashing
+plus equality); longest-prefix matches are only ever consumed through
+page-boundary truncation, and the router re-verifies token equality of
+the truncated prefix before adopting. Eviction is LRU with both an
+entry and a byte budget; a single snapshot larger than the whole byte
+budget is REJECTED at put (``prefix_cache_oversize_rejected_total``)
+rather than retained forever.
 
 HOST-ONLY CONTRACT: never imports jax (jaxlint JX5); snapshots are
 numpy arrays produced by the batcher's packed export.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from collections import OrderedDict
 
+import numpy as np
+
 __all__ = ["PrefixCache", "PrefixEntry"]
+
+logger = logging.getLogger("bigdl_tpu.serving")
+
+# Numpy mirror of parameters/compression.py int8_quantize/int8_dequantize
+# (deterministic path): symmetric per-vector scale over the last axis
+# with the same 1e-30 floor, so a cache-side round-trip is bit-identical
+# to the device codec's.  np.round matches jnp.round (half-to-even).
+_SCALE_FLOOR = 1e-30
+
+
+def _q8_encode(a):
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    scale = (np.max(np.abs(a), axis=-1) / 127.0 + _SCALE_FLOOR).astype(
+        np.float32)
+    q = np.clip(np.round(a / scale[..., None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _q8_decode(q, scale):
+    return q.astype(np.float32) * scale[..., None]
 
 
 class PrefixEntry:
-    """One retained prefix: the snapshot plus its sticky-replica
-    preference and hit count."""
+    """One retained prefix: the snapshot (fp32, or int8 + scales when
+    the cache stores quantized) plus its sticky-replica preference and
+    hit count."""
 
-    __slots__ = ("prompt", "replica", "snapshot", "hits")
+    __slots__ = ("prompt", "replica", "hits", "nbytes",
+                 "_snap", "_q8", "_meta", "_snap_cls")
 
-    def __init__(self, prompt, replica, snapshot):
+    def __init__(self, prompt, replica, snapshot, *, store_int8=False):
         self.prompt = tuple(prompt)
         self.replica = replica
-        self.snapshot = snapshot
         self.hits = 0
+        quantize = store_int8 and all(
+            np.issubdtype(np.asarray(k).dtype, np.floating)
+            and np.issubdtype(np.asarray(v).dtype, np.floating)
+            for k, v in snapshot.kv)
+        if quantize:
+            self._snap = None
+            # class ref, not an import: keeps this module jax-free
+            # (constructing a KVSnapshot needs no jax either way).
+            self._snap_cls = type(snapshot)
+            self._q8 = [(_q8_encode(k) + _q8_encode(v))
+                        for k, v in snapshot.kv]
+            self._meta = {
+                "prompt": tuple(snapshot.prompt),
+                "n_cached": snapshot.n_cached,
+                "last_token": snapshot.last_token,
+                "emitted": list(snapshot.emitted),
+                "page_size": snapshot.page_size,
+                "weight_version": getattr(snapshot, "weight_version",
+                                          None),
+            }
+            self.nbytes = sum(a.nbytes for layer in self._q8
+                              for a in layer)
+        else:
+            self._snap = snapshot
+            self._snap_cls = None
+            self._q8 = None
+            self._meta = None
+            self.nbytes = snapshot.nbytes
+
+    @property
+    def quantized(self) -> bool:
+        return self._q8 is not None
+
+    @property
+    def snapshot(self):
+        """The adoptable snapshot (dequantized fresh per access when
+        stored int8 — adopters may donate/truncate it)."""
+        if self._q8 is None:
+            return self._snap
+        m = self._meta
+        kv = [(_q8_decode(qk, sk), _q8_decode(qv, sv))
+              for qk, sk, qv, sv in self._q8]
+        return self._snap_cls(
+            list(m["prompt"]), m["n_cached"], kv,
+            last_token=m["last_token"], emitted=list(m["emitted"]),
+            page_size=m["page_size"],
+            weight_version=m["weight_version"])
+
+
+class _RadixNode:
+    """Trie node keyed on ``page_size``-token blocks. ``entries`` holds
+    the entries whose prompt has exactly this many full blocks (their
+    sub-page tail, if any, disambiguated by the full prompt key)."""
+
+    __slots__ = ("children", "entries")
+
+    def __init__(self):
+        self.children: dict[tuple, _RadixNode] = {}
+        self.entries: dict[tuple, PrefixEntry] = {}
 
 
 class PrefixCache:
-    """LRU map of token prefix -> :class:`PrefixEntry`.
+    """Radix-indexed LRU map of token prefix -> :class:`PrefixEntry`.
 
     ``min_tokens`` gates what is worth retaining: short prompts
     re-prefill faster than their snapshot round-trips. ``max_bytes``
     bounds the host memory the retained KV may hold (oldest evicted
-    first)."""
+    first; an entry alone exceeding the budget is rejected).
+    ``page_size`` is the block width of the radix index — align it
+    with the serving geometry's KV page size or partial matches floor
+    to coarser boundaries than the batcher could adopt.
+    ``longest_match=False`` restores exact-only behaviour
+    (``lookup_longest`` degrades to ``lookup`` and puts neither dedup
+    nor supersede)."""
 
     def __init__(self, capacity: int = 64, min_tokens: int = 16,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None, *, page_size: int = 16,
+                 longest_match: bool = True, store_int8: bool = False,
+                 registry=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.capacity = int(capacity)
         self.min_tokens = int(min_tokens)
         self.max_bytes = max_bytes
+        self.page_size = int(page_size)
+        self.longest_match = bool(longest_match)
+        self.store_int8 = bool(store_int8)
+        if registry is None:
+            from bigdl_tpu.observability.registry import default_registry
+            registry = default_registry()
+        self._m_oversize = registry.counter(
+            "prefix_cache_oversize_rejected_total",
+            "puts rejected because a single snapshot exceeded the "
+            "cache byte budget (previously retained forever)")
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        self._root = _RadixNode()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -75,9 +201,88 @@ class PrefixCache:
         with self._lock:
             return self._bytes
 
+    # -- radix plumbing (all called under self._lock) --
+    def _blocks(self, key: tuple) -> list:
+        s = self.page_size
+        return [key[i:i + s] for i in range(0, len(key) // s * s, s)]
+
+    def _walk(self, key: tuple) -> list:
+        """Nodes along ``key``'s full-block path, root first — stops at
+        the first divergence."""
+        path = [self._root]
+        node = self._root
+        for b in self._blocks(key):
+            node = node.children.get(b)
+            if node is None:
+                break
+            path.append(node)
+        return path
+
+    def _trie_insert(self, entry: PrefixEntry) -> None:
+        node = self._root
+        for b in self._blocks(entry.prompt):
+            nxt = node.children.get(b)
+            if nxt is None:
+                nxt = node.children[b] = _RadixNode()
+            node = nxt
+        node.entries[entry.prompt] = entry
+
+    def _trie_remove(self, entry: PrefixEntry) -> None:
+        blocks = self._blocks(entry.prompt)
+        path = [self._root]
+        node = self._root
+        for b in blocks:
+            node = node.children.get(b)
+            if node is None:      # never inserted (shouldn't happen)
+                return
+            path.append(node)
+        node.entries.pop(entry.prompt, None)
+        for i in range(len(path) - 1, 0, -1):
+            n = path[i]
+            if n.entries or n.children:
+                break
+            del path[i - 1].children[blocks[i - 1]]
+
+    def _drop(self, entry: PrefixEntry) -> None:
+        self._entries.pop(entry.prompt, None)
+        self._bytes -= entry.nbytes
+        self._trie_remove(entry)
+
+    @staticmethod
+    def _subtree_entry(node: _RadixNode) -> PrefixEntry | None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entries:
+                return next(iter(n.entries.values()))
+            stack.extend(n.children.values())
+        return None
+
+    def _covering(self, key: tuple) -> PrefixEntry | None:
+        """An entry whose prompt extends (or equals) ``key`` — i.e.
+        ``key`` is already fully served by the index."""
+        node = self._root
+        for b in self._blocks(key):
+            node = node.children.get(b)
+            if node is None:
+                return None
+        tail = key[len(key) // self.page_size * self.page_size:]
+        for e in node.entries.values():
+            if len(e.prompt) >= len(key) and e.prompt[:len(key)] == key:
+                return e
+        stack = [c for blk, c in node.children.items()
+                 if blk[:len(tail)] == tail]
+        while stack:
+            n = stack.pop()
+            if n.entries:       # every entry below here starts with key
+                return next(iter(n.entries.values()))
+            stack.extend(n.children.values())
+        return None
+
+    # -- lookups --
     def lookup(self, prompt) -> PrefixEntry | None:
         """The entry for EXACTLY ``prompt``, refreshing its LRU
-        position — or None."""
+        position — or None. Counts a hit/miss."""
         key = tuple(prompt)
         with self._lock:
             e = self._entries.get(key)
@@ -89,33 +294,106 @@ class PrefixCache:
             self.hits += 1
             return e
 
+    def lookup_longest(self, prompt) -> tuple:
+        """``(entry, matched_tokens)`` for the longest page-aligned
+        shared prefix — or ``(None, 0)``. An exact hit reports
+        ``matched_tokens == len(prompt)``; a partial hit reports the
+        full-page token count shared with the entry (always a multiple
+        of ``page_size``, possibly less than the entry's own length —
+        the caller truncates the snapshot to what it can use). Counts
+        one hit or miss, like :meth:`lookup`."""
+        key = tuple(prompt)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                e.hits += 1
+                self.hits += 1
+                return e, len(key)
+            if not self.longest_match:
+                self.misses += 1
+                return None, 0
+            path = self._walk(key)
+            matched = (len(path) - 1) * self.page_size
+            e = self._subtree_entry(path[-1]) if matched else None
+            if e is None:
+                self.misses += 1
+                return None, 0
+            self._entries.move_to_end(e.prompt)
+            e.hits += 1
+            self.hits += 1
+            return e, matched
+
+    def peek(self, prompt) -> PrefixEntry | None:
+        """Non-counting presence probe: is ``prompt`` already served by
+        the index (exactly, or covered by a longer entry)? No hit/miss
+        accounting, no LRU reshuffle — the router's capture hook uses
+        this so telemetry reflects only real dispatch traffic."""
+        key = tuple(prompt)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                return e
+            if not self.longest_match:
+                return None
+            return self._covering(key)
+
+    # -- mutation --
     def put(self, prompt, replica, snapshot) -> bool:
         """Retain ``snapshot`` for ``prompt``; returns whether it was
-        kept (prompts under ``min_tokens`` are not worth it). A repeat
-        put refreshes the entry (latest snapshot/replica wins)."""
+        kept. Prompts under ``min_tokens`` are not worth it; a snapshot
+        alone exceeding ``max_bytes`` is rejected (counter + warning);
+        a prompt already covered by a longer entry is deduped away. A
+        repeat put refreshes the entry (latest snapshot/replica wins),
+        and a put extending existing entries supersedes them."""
         key = tuple(prompt)
         if len(key) < self.min_tokens:
+            return False
+        entry = PrefixEntry(key, replica, snapshot,
+                            store_int8=self.store_int8)
+        if self.max_bytes is not None and entry.nbytes > self.max_bytes:
+            self._m_oversize.inc()
+            logger.warning(
+                "prefix_cache: rejecting %d-token snapshot (%d bytes > "
+                "max_bytes=%d) — it would evict the whole cache and "
+                "still not fit", len(key), entry.nbytes, self.max_bytes)
             return False
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._bytes -= old.snapshot.nbytes
-            e = PrefixEntry(key, replica, snapshot)
-            self._entries[key] = e
-            self._bytes += snapshot.nbytes
+                self._bytes -= old.nbytes
+                self._trie_remove(old)
+            elif self.longest_match:
+                cov = self._covering(key)
+                if cov is not None:
+                    # a longer entry already serves this prefix —
+                    # refresh it instead of storing a duplicate
+                    self._entries.move_to_end(cov.prompt)
+                    cov.replica = replica
+                    return False
+            self._trie_insert(entry)
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            if self.longest_match:
+                for n in self._walk(key):
+                    for e in list(n.entries.values()):
+                        if (len(e.prompt) < len(key)
+                                and e.prompt == key[:len(e.prompt)]):
+                            self._drop(e)   # superseded by this put
             while len(self._entries) > self.capacity or (
                     self.max_bytes is not None
                     and self._bytes > self.max_bytes
                     and len(self._entries) > 1):
                 _, evicted = self._entries.popitem(last=False)
-                self._bytes -= evicted.snapshot.nbytes
+                self._bytes -= evicted.nbytes
+                self._trie_remove(evicted)
             return True
 
     def invalidate(self, prompt) -> bool:
         with self._lock:
-            e = self._entries.pop(tuple(prompt), None)
+            e = self._entries.get(tuple(prompt))
             if e is not None:
-                self._bytes -= e.snapshot.nbytes
+                self._drop(e)
             return e is not None
 
     def forget_replica(self, name) -> int:
@@ -133,6 +411,7 @@ class PrefixCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._root = _RadixNode()
             self._bytes = 0
 
     def stats(self) -> dict:

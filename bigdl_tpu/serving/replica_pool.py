@@ -172,13 +172,15 @@ class Replica:
                 self.batcher.set_weights(model, weight_version)
 
     # -- request plane (router-facing; all under the replica lock) --
-    def submit(self, request_id, prompt=None, *, snapshot=None) -> None:
+    def submit(self, request_id, prompt=None, *, snapshot=None,
+               prefill_from=None) -> None:
         with self.lock:
             if self._state != ACTIVE:
                 raise RuntimeError(
                     f"replica {self.name} is {self._state}: not "
                     "admitting")
-            self.batcher.submit(request_id, prompt, snapshot=snapshot)
+            self.batcher.submit(request_id, prompt, snapshot=snapshot,
+                                prefill_from=prefill_from)
         self._wake.set()
 
     def cancel(self, request_id) -> bool:

@@ -11,7 +11,11 @@ arXiv:2204.01715). One ``submit()`` call per request; the router
 - **reuses prefixes**: a prompt seen before routes sticky to the
   replica that served it and ADOPTS the retained KV snapshot instead
   of re-prefilling (``router_prefix_hits_total`` at the router,
-  ``serving_prefill_skips_total`` on the adopting replica);
+  ``serving_prefill_skips_total`` on the adopting replica); a prompt
+  sharing only a PREFIX with a cached entry (the longest-prefix radix
+  walk, page-granular) adopts the truncated snapshot and prefills
+  just the suffix (``router_prefix_partial_hits_total``,
+  ``router_prefix_tokens_reused_total``);
 - **disaggregates** long prefills: prompts past
   ``slo.long_prefill_tokens`` prefill on the designated (or
   lowest-load) replica via ``prefill_only`` and the KV snapshot is
@@ -33,17 +37,21 @@ Results fan in through the batchers' ``on_complete`` hooks into one
 Locking: ``_state_lock`` guards only the router's own dicts and is
 never held while a replica lock is being acquired; replica driver
 threads call back into ``_on_complete`` holding their replica lock and
-take ``_state_lock`` briefly. That one-way order (replica -> state) is
-what makes the plane deadlock-free, and the declaration below turns it
-into a machine-checked gate (dev/analysis/raceguard.py TS1): acquiring
-``replica.lock`` anywhere while ``state_lock`` is held is a lint
-failure. The pending queue is flushed by a single dispatcher thread,
-so batcher-level arrival order is preserved.
+take ``_state_lock`` briefly, and the prefix-capture hook takes the
+prefix cache's internal lock the same way (replica -> prefixcache).
+The dispatch path queries the cache BEFORE touching any replica lock,
+so ``prefixcache._lock`` nests strictly inside ``replica.lock`` and
+never the reverse. Those one-way orders are what make the plane
+deadlock-free, and the declaration below turns them into a
+machine-checked gate (dev/analysis/raceguard.py TS1): acquiring
+``replica.lock`` anywhere while ``state_lock`` or the cache lock is
+held is a lint failure. The pending queue is flushed by a single
+dispatcher thread, so batcher-level arrival order is preserved.
 
 HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — routing is pure
 host orchestration over the batcher API.
 """
-# raceguard: order state_lock < replica.lock
+# raceguard: order state_lock < prefixcache._lock < replica.lock
 from __future__ import annotations
 
 import threading
@@ -103,6 +111,18 @@ class Router:
         self._m_prefix_hits = reg.counter(
             "router_prefix_hits_total",
             "requests served from the prefix KV cache (prefill skipped)")
+        self._m_prefix_partial = reg.counter(
+            "router_prefix_partial_hits_total",
+            "requests that adopted a truncated prefix snapshot and "
+            "prefilled only their suffix (longest-prefix radix hits)")
+        self._m_tokens_reused = reg.counter(
+            "router_prefix_tokens_reused_total",
+            "prompt tokens whose KV was adopted from the prefix cache "
+            "instead of prefilled (exact + partial hits)")
+        self._m_prompt_tokens = reg.counter(
+            "router_prompt_tokens_total",
+            "prompt tokens across all accepted requests (denominator "
+            "for the tokens-reused fraction)")
         self._m_disagg = reg.counter(
             "router_disagg_prefills_total",
             "long prompts prefilled on one replica, decoded on another")
@@ -188,7 +208,11 @@ class Router:
         def hook(rid, prompt, snapshot_fn):
             if len(prompt) < self.prefix.min_tokens:
                 return
-            if self.prefix.lookup(prompt) is not None:
+            # peek, not lookup: a presence probe must not count a
+            # hit/miss or reshuffle LRU order — capture traffic would
+            # otherwise pollute the cache telemetry (and with the radix
+            # index, skip when a LONGER entry already covers us)
+            if self.prefix.peek(prompt) is not None:
                 return          # already retained; skip the re-export
             self.prefix.put(prompt, name, snapshot_fn())
         return hook
@@ -248,6 +272,10 @@ class Router:
                         f"(slo.max_pending={self.slo.max_pending})")
                 self._pending.append((request_id, prompt, session))
                 self._m_pending.set(len(self._pending))
+        # counted once per ACCEPTED request (after the shed gate), so
+        # the tokens-reused fraction has a clean denominator even when
+        # pending work is re-dispatched several times
+        self._m_prompt_tokens.inc(len(prompt))
         tap = self.on_submit
         if tap is not None:
             try:
@@ -333,22 +361,34 @@ class Router:
                         len(payload.prompt),
                         candidates=len(cands)):
             if is_prompt:
-                hit = self.prefix.lookup(payload)
+                hit, matched = self.prefix.lookup_longest(payload)
                 if hit is not None and cands:
+                    # materialize once: int8-stored entries dequantize
+                    # per access, and version filter + adopt must see
+                    # the SAME snapshot object
+                    snap = hit.snapshot
                     vcands = [s for s in cands
-                              if self._version_ok(hit.snapshot, s.name)]
+                              if self._version_ok(snap, s.name)]
                     if vcands:
                         target = (hit.replica
                                   if hit.replica in {s.name
                                                      for s in vcands}
                                   else min(vcands,
                                            key=load_score).name)
-                        self.pool[target].submit(rid,
-                                                 snapshot=hit.snapshot)
-                        self._m_prefix_hits.inc()
-                        self._place(rid, target, session)
-                        return target
-                    # retained prefix from a superseded weight version:
+                        if list(hit.prompt) == payload:
+                            # exact: adopt everything, skip prefill
+                            self.pool[target].submit(rid, snapshot=snap)
+                            self._m_prefix_hits.inc()
+                            self._m_tokens_reused.inc(len(payload))
+                            self._place(rid, target, session)
+                            return target
+                        placed = self._adopt_partial(
+                            rid, payload, matched, snap, target,
+                            session)
+                        if placed is not None:
+                            return placed
+                    # retained prefix from a superseded weight version
+                    # (or no adoptable full page after truncation):
                     # fall through to a fresh prefill (the rollout's
                     # drains forget stale entries replica by replica)
                 if (len(payload) >= self.slo.long_prefill_tokens
@@ -364,6 +404,34 @@ class Router:
                 self.pool[target].submit(rid, snapshot=payload)
             self._place(rid, target, session)
             return target
+
+    def _adopt_partial(self, rid, prompt, matched, snap, target,
+                       session):
+        """Adopt the matched full pages of ``snap`` on ``target`` and
+        prefill only the suffix. Returns the replica name, or None to
+        fall back to a fresh prefill (no usable page boundary after
+        truncation, or the replica refused the job)."""
+        try:
+            # leave >= 1 suffix token so there is a logit to sample:
+            # truncate floors to the snapshot's page boundary
+            trunc = snap.truncate(min(matched, len(prompt) - 1))
+        except ValueError:
+            return None           # under one full page after flooring
+        if list(trunc.prompt) != prompt[:trunc.n_cached]:
+            return None           # never adopt mismatched KV
+        try:
+            with trace.span("suffix adopt", cat="serving",
+                            prompt_len=len(prompt),
+                            reused=trunc.n_cached):
+                self.pool[target].submit(
+                    rid, prompt, snapshot=trunc,
+                    prefill_from=trunc.n_cached)
+        except (RuntimeError, ValueError):
+            return None           # transient refusal -> fresh prefill
+        self._m_prefix_partial.inc()
+        self._m_tokens_reused.inc(trunc.n_cached)
+        self._place(rid, target, session)
+        return target
 
     def _pick(self, cands, session) -> str:
         if session is not None:
@@ -564,6 +632,11 @@ class Router:
             "decode_token_p50_s": percentile(dec, 0.5),
             "decode_token_p99_s": percentile(dec, 0.99),
             "prefix_hits": int(self._m_prefix_hits.value()),
+            "prefix_partial_hits": int(self._m_prefix_partial.value()),
+            "prefix_tokens_reused": int(self._m_tokens_reused.value()),
+            "prefix_tokens_reused_fraction": (
+                self._m_tokens_reused.value()
+                / max(1.0, self._m_prompt_tokens.value())),
             "disagg_prefills": int(self._m_disagg.value()),
         }
 

@@ -36,7 +36,8 @@ MODULES = {
     "elastic": ["tests/test_elastic.py"],
     "serving": ["tests/test_serving_router.py",
                 "tests/test_autoscaler.py",
-                "tests/test_quantized_serving.py"],
+                "tests/test_quantized_serving.py",
+                "tests/test_prefix_cache.py"],
     "deploy": ["tests/test_deploy.py"],
     "harness": ["tests/test_bench_contract.py"],
     "lint": ["tests/test_jaxlint.py", "tests/test_raceguard.py",

@@ -1219,3 +1219,56 @@ class TestPublishRow:
         assert row["fleet_version"] == "v2"
         assert row["rollback_drill_outcome"] == "canary_failed"
         assert row["rollback_kept_fleet"] is True
+
+
+class TestPrefixReuseRow:
+    """ISSUE 18: prefix_reuse_ttft — shared-system-prompt TTFT with
+    longest-prefix KV reuse ON vs exact-only — rides the standard
+    row/known/all contract. Lower is better and the gate knows."""
+
+    FAKE = {"metric": "prefix_reuse_ttft", "value": 0.019,
+            "unit": "seconds", "ttft_p50_s": 0.019,
+            "ttft_p99_s": 0.027, "exact_ttft_p50_s": 0.027,
+            "exact_ttft_p99_s": 0.031, "speedup_p50": 1.37,
+            "partial_hits": 10, "tokens_reused_fraction": 0.75,
+            "first_tokens_match": True, "n_requests": 10}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_prefix_reuse_ttft",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "prefix_reuse_ttft",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "prefix_reuse_ttft"
+        assert lines[-1]["rows"][0]["value"] == 0.019
+        with open(out) as f:
+            assert "bench_prefix_reuse_ttft 0.019" in f.read()
+
+    def test_row_in_all_and_gate_direction(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "prefix_reuse_ttft" in \
+            [r["metric"] for r in agg["rows"]]
+        # a slower reuse-ON TTFT is the regression
+        assert "prefix_reuse_ttft" in bench._GATE_LOWER_IS_BETTER
+
+    @pytest.mark.slow
+    def test_real_probe_reuses_and_matches(self):
+        """The REAL drill (tiny geometry): every wave request must be
+        a partial hit, the reused-token fraction must clear the 0.5
+        acceptance bar, and the reuse run's first tokens must equal
+        the exact-only run's."""
+        row = bench.bench_prefix_reuse_ttft(n_requests=6, max_new=4,
+                                            d_model=32, num_layers=2)
+        assert row["metric"] == "prefix_reuse_ttft"
+        assert row["value"] > 0
+        assert row["partial_hits"] > 0
+        assert row["tokens_reused_fraction"] >= 0.5
+        assert row["first_tokens_match"] is True

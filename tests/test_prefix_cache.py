@@ -1,0 +1,401 @@
+"""Radix prefix cache + suffix-only prefill (ISSUE 18).
+
+The load-bearing contracts:
+
+- ``lookup_longest`` returns the longest page-aligned shared prefix —
+  pinned against a brute-force oracle over random token sets;
+- insertion dedups shared prefixes: a put covered by a longer entry is
+  skipped, a put extending a shorter entry supersedes it;
+- ``peek`` is a pure presence probe: no hit/miss accounting, no LRU
+  reshuffle (the router's capture hook depends on this);
+- byte accounting holds through int8 entries (stored quantized via the
+  numpy mirror of the ``parameters/compression.py`` codec), and a
+  single snapshot larger than ``max_bytes`` is REJECTED with a counter
+  instead of retained forever;
+- suffix-only prefill is bitwise: adopt-prefix + prefill-suffix at any
+  page-boundary split equals full prefill — first token AND greedy
+  continuation — on the dense and interpret-mode paged kernels; an
+  int8-stored prefix preserves the first token exactly (ISSUE 15's
+  tolerance idiom) with >= 0.9 greedy-token agreement vs fp32.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+from bigdl_tpu.observability.exporter import HealthRegistry
+from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.serving import PrefixCache
+
+S = 4           # radix block (page) size for the index unit tests
+V = 32
+
+
+class FakeSnap:
+    """Shape-compatible KVSnapshot stand-in: just enough surface for
+    the index (``prompt``/``kv``/``nbytes`` + the reconstruction
+    kwargs an int8 entry passes back to ``type(snapshot)``)."""
+
+    def __init__(self, prompt, n_cached=None, kv=None, *,
+                 last_token=None, emitted=(), page_size=S,
+                 weight_version=None):
+        self.prompt = list(prompt)
+        self.n_cached = (len(self.prompt) if n_cached is None
+                         else n_cached)
+        self.kv = kv if kv is not None else [
+            (np.ones((2, page_size, 1, 8), np.float32),
+             np.ones((2, page_size, 1, 8), np.float32))]
+        self.last_token = (self.prompt[-1] if last_token is None
+                           else last_token)
+        self.emitted = list(emitted)
+        self.page_size = page_size
+        self.weight_version = weight_version
+
+    @property
+    def nbytes(self):
+        return sum(np.asarray(k).nbytes + np.asarray(v).nbytes
+                   for k, v in self.kv)
+
+
+def _pc(**kw):
+    kw.setdefault("min_tokens", S)
+    kw.setdefault("page_size", S)
+    kw.setdefault("registry", MetricRegistry())
+    return PrefixCache(**kw)
+
+
+class TestRadixLookup:
+    def test_longest_match_vs_bruteforce_oracle(self):
+        """Random token sets over a tiny alphabet (so prefixes collide
+        constantly): ``lookup_longest`` must agree with a brute-force
+        block-compare over every retained entry, for stored prompts,
+        near-misses and unrelated queries alike."""
+        rs = np.random.RandomState(0)
+        pc = _pc(capacity=512)
+        stored = []
+        for _ in range(60):
+            p = tuple(rs.randint(1, 4, size=(16,)).tolist())
+            if p not in stored and pc.put(p, "r", FakeSnap(p)):
+                stored.append(p)
+        queries = [list(rs.randint(1, 4, size=(n,)))
+                   for n in rs.randint(3, 21, size=(120,))]
+        queries += [list(p) for p in stored[:10]]
+        queries += [list(p[:9]) + [99] for p in stored[:10]]
+        for q in queries:
+            want = 0
+            if tuple(q) in stored:
+                want = len(q)
+            else:
+                for p in stored:
+                    blocks = 0
+                    for i in range(0, len(q) // S * S, S):
+                        if tuple(q[i:i + S]) != p[i:i + S]:
+                            break
+                        blocks += 1
+                    want = max(want, blocks * S)
+            e, matched = pc.lookup_longest(q)
+            assert matched == want, (q, matched, want)
+            if want == 0:
+                assert e is None
+            else:
+                assert e.prompt[:matched] == tuple(q[:matched])
+
+    def test_exact_lookup_backcompat(self):
+        pc = _pc()
+        p = list(range(1, 9))
+        pc.put(p, "r0", FakeSnap(p))
+        e = pc.lookup(p)
+        assert e is not None and e.replica == "r0"
+        assert pc.lookup(p[:4] + [9, 9, 9, 9]) is None
+        assert (pc.hits, pc.misses) == (1, 1)
+
+    def test_partial_hit_counts_once(self):
+        pc = _pc()
+        p = list(range(1, 13))
+        pc.put(p, "r0", FakeSnap(p))
+        e, matched = pc.lookup_longest(p[:8] + [30, 31, 30, 31])
+        assert e is not None and matched == 8
+        assert (pc.hits, pc.misses) == (1, 0)
+
+    def test_longest_match_disabled_is_exact_only(self):
+        pc = _pc(longest_match=False)
+        p = list(range(1, 13))
+        pc.put(p, "r0", FakeSnap(p))
+        assert pc.lookup_longest(p) == (pc.lookup(p), len(p))
+        e, matched = pc.lookup_longest(p[:8] + [30, 31])
+        assert (e, matched) == (None, 0)
+
+
+class TestMutation:
+    def test_put_covered_by_longer_entry_is_deduped(self):
+        pc = _pc()
+        long = list(range(1, 17))
+        assert pc.put(long, "r0", FakeSnap(long))
+        assert pc.put(long[:8], "r1", FakeSnap(long[:8])) is False
+        assert len(pc) == 1
+        # the covering entry still serves the short prompt
+        e, matched = pc.lookup_longest(long[:8])
+        assert e.prompt == tuple(long) and matched == 8
+
+    def test_put_extending_entry_supersedes_it(self):
+        pc = _pc()
+        short = list(range(1, 9))
+        long = short + [20, 21, 22, 23]
+        pc.put(short, "r0", FakeSnap(short))
+        assert pc.put(long, "r1", FakeSnap(long))
+        assert len(pc) == 1
+        assert pc.lookup(short) is None         # dropped
+        e, matched = pc.lookup_longest(short)
+        assert e.prompt == tuple(long) and matched == 8
+
+    def test_unrelated_entries_coexist(self):
+        pc = _pc()
+        a, b = [1] * 8, [2] * 8
+        pc.put(a, "r0", FakeSnap(a))
+        pc.put(b, "r0", FakeSnap(b))
+        assert len(pc) == 2
+        assert pc.lookup_longest(a)[0].prompt == tuple(a)
+        assert pc.lookup_longest(b)[0].prompt == tuple(b)
+
+    def test_lru_eviction_order(self):
+        pc = _pc(capacity=2)
+        a, b, c = [1] * 8, [2] * 8, [3] * 8
+        pc.put(a, "r", FakeSnap(a))
+        pc.put(b, "r", FakeSnap(b))
+        pc.lookup(a)                 # refresh: b is now oldest
+        pc.put(c, "r", FakeSnap(c))
+        assert pc.lookup(b) is None
+        assert pc.lookup(a) is not None
+        # the trie dropped b's path too, not just the LRU entry
+        assert pc.lookup_longest(b[:4] + [9] * 4) == (None, 0)
+
+    def test_byte_budget_evicts_oldest(self):
+        per = FakeSnap([1] * 8).nbytes
+        pc = _pc(max_bytes=2 * per)
+        a, b, c = [1] * 8, [2] * 8, [3] * 8
+        pc.put(a, "r", FakeSnap(a))
+        pc.put(b, "r", FakeSnap(b))
+        pc.put(c, "r", FakeSnap(c))
+        assert len(pc) == 2 and pc.nbytes == 2 * per
+        assert pc.lookup(a) is None
+
+    def test_oversize_put_rejected_with_counter(self):
+        reg = MetricRegistry()
+        per = FakeSnap([1] * 8).nbytes
+        pc = _pc(max_bytes=per // 2, registry=reg)
+        assert pc.put([1] * 8, "r", FakeSnap([1] * 8)) is False
+        assert len(pc) == 0 and pc.nbytes == 0
+        assert reg.get(
+            "prefix_cache_oversize_rejected_total").value() == 1
+
+    def test_forget_replica_keeps_snapshots(self):
+        pc = _pc()
+        a, b = [1] * 8, [2] * 8
+        pc.put(a, "gone", FakeSnap(a))
+        pc.put(b, "kept", FakeSnap(b))
+        assert pc.forget_replica("gone") == 1
+        e = pc.lookup(a)
+        assert e.replica is None and e.snapshot is not None
+        assert pc.lookup(b).replica == "kept"
+
+    def test_invalidate_and_clear_reset_trie(self):
+        pc = _pc()
+        a = [1] * 12
+        pc.put(a, "r", FakeSnap(a))
+        assert pc.invalidate(a)
+        assert pc.lookup_longest(a) == (None, 0)
+        pc.put(a, "r", FakeSnap(a))
+        pc.clear()
+        assert len(pc) == 0 and pc.nbytes == 0
+        assert pc.lookup_longest(a) == (None, 0)
+
+
+class TestPeek:
+    def test_peek_counts_nothing_and_keeps_lru_order(self):
+        pc = _pc(capacity=2)
+        a, b = [1] * 8, [2] * 8
+        pc.put(a, "r", FakeSnap(a))
+        pc.put(b, "r", FakeSnap(b))
+        assert pc.peek(a) is not None
+        assert pc.peek([9] * 8) is None
+        assert (pc.hits, pc.misses) == (0, 0)
+        # a was peeked but NOT refreshed: still the eviction victim
+        pc.put([3] * 8, "r", FakeSnap([3] * 8))
+        assert pc.lookup(a) is None
+
+    def test_peek_sees_covering_entries(self):
+        pc = _pc()
+        long = list(range(1, 17))
+        pc.put(long, "r", FakeSnap(long))
+        assert pc.peek(long[:8]) is not None     # page-aligned cover
+        assert pc.peek(long[:10]) is not None    # mid-page cover
+        assert pc.peek(long[:8] + [99]) is None
+        assert (pc.hits, pc.misses) == (0, 0)
+
+
+class TestInt8Entries:
+    def _snap(self, n=12, seed=0):
+        rs = np.random.RandomState(seed)
+        kv = [(rs.randn(3, S, 1, 8).astype(np.float32),
+               rs.randn(3, S, 1, 8).astype(np.float32))
+              for _ in range(2)]
+        return FakeSnap(list(rs.randint(1, V, size=(n,))), kv=kv,
+                        weight_version="v7")
+
+    def test_byte_accounting_and_roundtrip(self):
+        snap = self._snap()
+        pc = _pc(store_int8=True)
+        assert pc.put(snap.prompt, "r0", snap)
+        e = pc.lookup(snap.prompt)
+        assert e.quantized
+        assert e.nbytes < snap.nbytes / 2      # int8 + per-vector scale
+        assert pc.nbytes == e.nbytes           # accounted at stored size
+        back = e.snapshot
+        assert type(back) is FakeSnap
+        assert (back.prompt, back.n_cached) == (snap.prompt, snap.n_cached)
+        assert (back.page_size, back.weight_version) == (S, "v7")
+        assert back.emitted == []
+        for (k0, v0), (k1, v1) in zip(snap.kv, back.kv):
+            for a, b in ((k0, k1), (v0, v1)):
+                bound = np.max(np.abs(a), axis=-1) / 127 + 1e-6
+                assert np.all(np.abs(a - b) <= bound[..., None])
+
+    def test_matches_device_codec_bitwise(self):
+        """The numpy mirror must round-trip EXACTLY like the jax codec
+        in parameters/compression.py — an int8 cache entry and an int8
+        weight wire see the same values."""
+        from bigdl_tpu.parameters.compression import (int8_dequantize,
+                                                      int8_quantize)
+        from bigdl_tpu.serving.prefix_cache import _q8_decode, _q8_encode
+        rs = np.random.RandomState(3)
+        x = rs.randn(5, 7, 8).astype(np.float32)
+        qn, sn = _q8_encode(x)
+        qj, sj = int8_quantize(x)
+        np.testing.assert_array_equal(qn, np.asarray(qj))
+        np.testing.assert_array_equal(sn, np.asarray(sj))
+        np.testing.assert_array_equal(
+            _q8_decode(qn, sn), np.asarray(int8_dequantize(qj, sj)))
+
+    def test_non_float_kv_stays_unquantized(self):
+        snap = self._snap()
+        snap.kv = [(k.astype(np.int8), v.astype(np.int8))
+                   for k, v in snap.kv]
+        pc = _pc(store_int8=True)
+        pc.put(snap.prompt, "r0", snap)
+        e = pc.lookup(snap.prompt)
+        assert not e.quantized and e.snapshot is snap
+
+
+GEO = dict(max_batch=1, num_pages=32, page_size=8, max_new_tokens=5,
+           max_burst=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                      max_len=64)
+    m.materialize(jax.random.PRNGKey(6))
+    m.evaluate()
+    return m
+
+
+def _batcher(model, **kw):
+    return ContinuousBatcher(model, registry=MetricRegistry(),
+                             health=HealthRegistry(), **GEO, **kw)
+
+
+def _prompt(n=40, seed=0):
+    rs = np.random.RandomState(seed)
+    return list(rs.randint(1, V + 1, size=(n,)))
+
+
+class TestSuffixPrefillParity:
+    """ISSUE 18 acceptance: adopt-prefix + prefill-suffix is BITWISE
+    equal to full prefill (first token and greedy continuation) at
+    every page-boundary split, on the dense and interpret paged
+    kernels."""
+
+    @pytest.mark.parametrize("kernel", ["dense", "interpret"])
+    def test_bitwise_at_page_boundaries(self, model, kernel):
+        prompt = _prompt()
+        cb = _batcher(model, paged_kernel=kernel)
+        cb.submit("full", prompt)
+        full = dict(cb.run_to_completion())["full"]
+        snap = _batcher(model, paged_kernel=kernel).prefill_only(
+            "cap", prompt)
+        for split in (8, 16, 32):
+            t = snap.truncate(split)
+            assert t.n_cached == split and t.is_prefix_only
+            assert list(t.prompt) == prompt[:split]
+            b = _batcher(model, paged_kernel=kernel)
+            b.submit("sfx", prompt, snapshot=t, prefill_from=split)
+            out = dict(b.run_to_completion())["sfx"]
+            np.testing.assert_array_equal(
+                out, full, err_msg=f"{kernel} split {split}")
+            assert int(b._m_suffix.value()) == 1
+
+    def test_dense_interpret_identical(self, model):
+        prompt = _prompt(seed=1)
+        snap = _batcher(model).prefill_only("cap", prompt)
+        outs = {}
+        for kernel in ("dense", "interpret"):
+            b = _batcher(model, paged_kernel=kernel)
+            b.submit("s", prompt, snapshot=snap.truncate(16),
+                     prefill_from=16)
+            outs[kernel] = dict(b.run_to_completion())["s"]
+        np.testing.assert_array_equal(outs["dense"], outs["interpret"])
+
+    def test_int8_stored_prefix_first_token_parity(self, model):
+        """int8 snapshot storage round-trips through the cache: the
+        adopted (dequantized) prefix preserves the first token exactly
+        and nearly every greedy token (ISSUE 15's tolerance idiom)."""
+        prompt = _prompt(seed=2)
+        cb = _batcher(model)
+        cb.submit("full", prompt)
+        full = dict(cb.run_to_completion())["full"]
+        snap = _batcher(model).prefill_only("cap", prompt)
+        pc = PrefixCache(min_tokens=8, page_size=8, store_int8=True,
+                         registry=MetricRegistry())
+        assert pc.put(prompt, "r0", snap)
+        e, matched = pc.lookup_longest(prompt[:24] + [1, 2, 3, 4])
+        assert e.quantized and matched == 24
+        t = e.snapshot.truncate(24)
+        b = _batcher(model)
+        b.submit("sfx", prompt, snapshot=t, prefill_from=24)
+        out = dict(b.run_to_completion())["sfx"]
+        assert out[0] == full[0], "int8 first-token parity"
+        assert float(np.mean(np.asarray(out) == np.asarray(full))) \
+            >= 0.9
+
+    def test_truncate_contract(self, model):
+        snap = _batcher(model).prefill_only("cap", _prompt())
+        t = snap.truncate(19)               # floors to the page boundary
+        assert t.n_cached == 16 and len(t.prompt) == 16
+        assert t.last_token == t.prompt[-1]
+        assert t.weight_version == snap.weight_version
+        for (k, v), (k0, v0) in zip(t.kv, snap.kv):
+            assert k.shape[0] == 2          # 16 tokens / page_size 8
+            np.testing.assert_array_equal(k, k0[:2])
+            np.testing.assert_array_equal(v, v0[:2])
+        with pytest.raises(ValueError):
+            snap.truncate(7)                # under one full page
+
+    def test_submit_validation(self, model):
+        prompt = _prompt()
+        snap = _batcher(model).prefill_only("cap", prompt)
+        b = _batcher(model)
+        with pytest.raises(ValueError, match="prefill_from"):
+            b.submit("a", prompt, snapshot=snap.truncate(16),
+                     prefill_from=12)       # not the snapshot length
+        with pytest.raises(ValueError):
+            b.submit("b", prompt[:16], snapshot=snap.truncate(16),
+                     prefill_from=16)       # no suffix left
+        with pytest.raises(ValueError):
+            # prefix-only snapshots need prefill_from + full prompt
+            b.submit("c", snapshot=snap.truncate(16))
+        wrong = prompt[:8] + [1] * 32
+        with pytest.raises(ValueError):
+            b.submit("d", wrong, snapshot=snap.truncate(16),
+                     prefill_from=16)       # prompt != snapshot prefix

@@ -183,6 +183,88 @@ class TestPrefixRouting:
             pool.close()
 
 
+class TestLongestPrefixRouting:
+    """ISSUE 18: the radix index at the router — partial hits adopt a
+    truncated snapshot and prefill only the suffix, and the capture
+    hook no longer pollutes the cache telemetry."""
+
+    def test_capture_does_not_pollute_counters(self, model):
+        """The capture hook uses ``peek``: hit/miss counters and LRU
+        order reflect only real dispatch lookups."""
+        health, reg, pool, router = _plane(
+            model, prefix=PrefixCache(min_tokens=4, page_size=4))
+        try:
+            p = _prompts([24], seed=21)[0]
+            router.submit("a", p)
+            router.wait_all(timeout=60)
+            # dispatch looked up once (miss); the capture hook's
+            # presence probe counted NOTHING
+            assert (router.prefix.hits, router.prefix.misses) == (0, 1)
+            assert len(router.prefix) == 1
+            router.submit("b", p)
+            router.wait_all(timeout=60)
+            assert (router.prefix.hits, router.prefix.misses) == (1, 1)
+            assert reg.get("router_prefix_hits_total").value() == 1
+        finally:
+            router.close()
+            pool.close()
+
+    @pytest.mark.slow
+    def test_partial_hits_suffix_prefill_and_drain(self, model):
+        """End-to-end drill: prompts sharing a 3-page prefix with
+        distinct suffixes produce greedy results identical to fresh
+        prefills while the router counts partial hits and reused
+        tokens; a queued suffix job survives a drain by re-dispatching
+        as its full prompt on the survivor."""
+        prefix = PrefixCache(min_tokens=4, page_size=4)
+        health, reg, pool, router = _plane(model, prefix=prefix)
+        try:
+            rs = np.random.RandomState(22)
+            shared = list(rs.randint(1, V + 1, size=(12,)))
+            sfx = [list(rs.randint(1, V + 1, size=(6,)))
+                   for _ in range(4)]
+            seeded = router.submit("seed", shared + sfx[0])
+            router.wait_all(timeout=60)
+            for i in (1, 2):
+                router.submit(f"q{i}", shared + sfx[i])
+            router.wait_all(timeout=60)
+            res = dict(router.finished())
+            for rid, p in [("seed", shared + sfx[0]),
+                           ("q1", shared + sfx[1]),
+                           ("q2", shared + sfx[2])]:
+                np.testing.assert_array_equal(res[rid],
+                                              _greedy(model, p),
+                                              err_msg=rid)
+            assert reg.get(
+                "router_prefix_partial_hits_total").value() == 2
+            assert reg.get(
+                "router_prefix_tokens_reused_total").value() == 24
+            lat = router.latency_summary()
+            assert lat["prefix_partial_hits"] == 2
+            assert lat["prefix_tokens_reused"] == 24
+            assert 0.0 < lat["prefix_tokens_reused_fraction"] < 1.0
+            suffix_prefills = sum(
+                int(r.batcher._m_suffix.value()) for r in pool)
+            assert suffix_prefills == 2
+
+            # queued suffix job across a drain: freeze the sticky
+            # replica so the job parks in ITS queue, then drain — it
+            # must re-dispatch as a full prompt and reuse the prefix
+            # on the survivor
+            with pool[seeded].lock:
+                router.submit("q3", shared + sfx[3])
+                router.drain(seeded)
+            router.wait_all(timeout=60)
+            out = dict(router.finished())["q3"]
+            np.testing.assert_array_equal(
+                out, _greedy(model, shared + sfx[3]))
+            assert reg.get(
+                "router_prefix_partial_hits_total").value() >= 3
+        finally:
+            router.close()
+            pool.close()
+
+
 class TestAdmission:
     def test_saturation_parks_then_completes(self, model):
         """With both drivers frozen and per-replica queue depth capped,
