@@ -1610,6 +1610,150 @@ def bench_prefix_reuse_ttft(**kw):
     }
 
 
+def _bench_request_trace_run(*, n_requests: int = 10, max_new: int = 8,
+                             d_model: int = 256, num_layers: int = 4):
+    """Per-request timeline cost + attribution drill (ISSUE 19).
+
+    Overhead: the same single-bucket workload through a 1-replica
+    router twice — request tracker ON (``sample_every=1``: every
+    timeline retained, the worst case) vs OFF (``tracker=False``) —
+    with per-request TTFTs read exactly off the TTFT histogram ``sum``
+    around each sequential submit (the ``prefix_reuse`` measurement
+    pattern). The modes run identical code paths except the tracker
+    events, so the p50 ratio IS the tentpole's hot-path cost.
+
+    Drill: a fresh tracker-ON plane whose replica driver is NOT
+    started and whose admission gate allows one queued request, so
+    submissions wait (router pending or replica queue) for an induced
+    delay before the driver starts. ~All of the tail's latency is
+    queue wait by construction, and the tracker's attribution must say
+    so (the ISSUE 19 receipt wants >= 80% queue fraction)."""
+    import jax
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer.serving import ContinuousBatcher
+    from bigdl_tpu.observability.exporter import HealthRegistry
+    from bigdl_tpu.observability.registry import MetricRegistry
+    from bigdl_tpu.observability.request_trace import RequestTracker
+    from bigdl_tpu.serving import ReplicaPool, Router, SLOConfig
+
+    _set_bf16_policy()
+    vocab, page = 8192, 16
+    model = TransformerLM(vocab, d_model=d_model, num_heads=4,
+                          num_layers=num_layers, max_len=320,
+                          with_log_softmax=False, num_kv_heads=1)
+    model.materialize(jax.random.PRNGKey(0))
+    model.evaluate()
+    host = np.random.default_rng(11)
+    prompts = [list(host.integers(1, vocab + 1, size=(page,)))
+               for _ in range(n_requests + 1)]
+    geo = dict(max_batch=4, num_pages=96, page_size=page,
+               max_new_tokens=max_new, max_burst=8)
+    # pay the (bucket-16 prefill, decode) compiles once up front —
+    # jit caches are module-level, so every plane below reuses them
+    warm = ContinuousBatcher(model, registry=MetricRegistry(),
+                             health=HealthRegistry(), **geo)
+    warm.submit("wf", prompts[0])
+    warm.run_to_completion()
+
+    slo = SLOConfig(ttft_p99_s=2.5, decode_token_p99_s=0.5,
+                    long_prefill_tokens=10_000)
+    out = {}
+    for mode in ("on", "off"):
+        health = HealthRegistry()
+        reg = MetricRegistry()
+        pool = ReplicaPool(model, 1, health=health, **geo)
+        tracker = (RequestTracker(slo=slo, sample_every=1)
+                   if mode == "on" else False)
+        router = Router(pool, slo=slo, registry=reg, health=health,
+                        tracker=tracker, capture_prefixes=False)
+        try:
+            router.submit("seed", prompts[0])
+            router.wait_all(timeout=300)
+            router.finished()
+
+            def _ttft_sum():
+                return sum(
+                    r.histogram_snapshot("serving_ttft_seconds")["sum"]
+                    for r in pool)
+
+            ttfts = []
+            for i in range(1, n_requests + 1):
+                s0 = _ttft_sum()
+                router.submit(i, prompts[i])
+                router.wait_all(timeout=300)
+                ttfts.append(_ttft_sum() - s0)
+            row = {"ttft_p50_s": float(np.percentile(ttfts, 50)),
+                   "ttft_p99_s": float(np.percentile(ttfts, 99))}
+            if mode == "on":
+                st = tracker.stats()
+                row["timelines"] = st["started"]
+                row["retained"] = st["retained"]
+            out[mode] = row
+        finally:
+            router.close()
+            pool.close()
+
+    # -- induced queue-delay drill --
+    delay_s = 0.3
+    drill_slo = SLOConfig(ttft_p99_s=2.5, decode_token_p99_s=0.5,
+                          max_queue_depth=1,
+                          long_prefill_tokens=10_000)
+    health = HealthRegistry()
+    pool = ReplicaPool(model, 1, health=health, start=False, **geo)
+    tracker = RequestTracker(slo=drill_slo, sample_every=1)
+    router = Router(pool, slo=drill_slo, registry=MetricRegistry(),
+                    health=health, tracker=tracker,
+                    capture_prefixes=False)
+    try:
+        for i in range(6):
+            router.submit(f"d{i}", prompts[i])
+        time.sleep(delay_s)
+        pool.start()
+        router.wait_all(timeout=300)
+        router.finished()
+        attr = tracker.attribution()
+        out["drill"] = {"delay_s": delay_s,
+                        "queue_fraction": attr["fractions"]["queue_s"],
+                        "attribution": attr}
+    finally:
+        router.close()
+        pool.close()
+    return out, geo
+
+
+def bench_request_trace_overhead(**kw):
+    """What per-request timelines cost on the TTFT path: ``value`` is
+    the tracker-ON p50 TTFT over the tracker-OFF p50 (1.0 = free; the
+    ISSUE 19 acceptance wants <= 1.05), with the induced
+    queue-delay drill's attribution verdict riding as fields."""
+    out, geo = _bench_request_trace_run(**kw)
+    on, off = out["on"], out["off"]
+    ratio = on["ttft_p50_s"] / max(off["ttft_p50_s"], 1e-9)
+    qfrac = out["drill"]["queue_fraction"]
+    params = _fmt_params(kw.get("d_model", 256),
+                         kw.get("num_layers", 4))
+    return {
+        "metric": "request_trace_overhead",
+        "value": round(ratio, 4),
+        "unit": "x (tracker-ON p50 TTFT / tracker-OFF)",
+        "ttft_p50_on_s": round(on["ttft_p50_s"], 5),
+        "ttft_p50_off_s": round(off["ttft_p50_s"], 5),
+        "ttft_p99_on_s": round(on["ttft_p99_s"], 5),
+        "ttft_p99_off_s": round(off["ttft_p99_s"], 5),
+        "within_overhead_budget": bool(ratio <= 1.05),
+        "timelines": on["timelines"],
+        "retained": on["retained"],
+        "drill_queue_fraction": round(qfrac, 4),
+        "drill_queue_attributed": bool(qfrac >= 0.8),
+        "drill_delay_s": out["drill"]["delay_s"],
+        "n_requests": kw.get("n_requests", 10),
+        "geometry": (f"{params} MQA 1x({geo['max_batch']} slots, "
+                     f"{geo['num_pages']} pages x {geo['page_size']}) "
+                     f"16-token prompts +{geo['max_new_tokens']}"),
+    }
+
+
 def bench_serving_decode_hbm(**geometry):
     """Static per-decode-step HBM accounting, dense view vs the Pallas
     paged kernel (ISSUE 9 — the tentpole's measured receipt): lowers
@@ -1989,7 +2133,8 @@ _GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
                          "collective_wire_bytes_per_step",
                          "autoscale_time_to_capacity",
                          "publish_to_fleet_secs",
-                         "prefix_reuse_ttft"}
+                         "prefix_reuse_ttft",
+                         "request_trace_overhead"}
 
 GATE_EXIT_CODE = 4
 
@@ -2329,7 +2474,7 @@ def _run(args):
                 "train_peak_hbm_bytes", "multichip_scaling",
                 "pipeline_bubble_fraction", "elastic_resume_secs",
                 "autoscale_time_to_capacity", "publish_to_fleet_secs",
-                "prefix_reuse_ttft"]
+                "prefix_reuse_ttft", "request_trace_overhead"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -2339,7 +2484,8 @@ def _run(args):
              "serving_decode_hbm_bytes", "train_peak_hbm_bytes",
              "multichip_scaling", "pipeline_bubble_fraction",
              "elastic_resume_secs", "autoscale_time_to_capacity",
-             "publish_to_fleet_secs", "prefix_reuse_ttft"}
+             "publish_to_fleet_secs", "prefix_reuse_ttft",
+             "request_trace_overhead"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -2396,6 +2542,7 @@ def _run(args):
         "autoscale_time_to_capacity": bench_autoscale_time_to_capacity,
         "publish_to_fleet_secs": bench_publish_to_fleet,
         "prefix_reuse_ttft": bench_prefix_reuse_ttft,
+        "request_trace_overhead": bench_request_trace_overhead,
     }
     rows_out: list[dict] = []
     headline_failed = False

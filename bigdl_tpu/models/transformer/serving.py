@@ -1498,6 +1498,17 @@ class ContinuousBatcher:
         # router wires them after construction)
         self.on_complete = on_complete
         self.on_prefill = on_prefill
+        # request-timeline plumbing (observability/request_trace.py):
+        # the router assigns ``tracker`` after construction (like the
+        # hooks above); ``replica_name`` is stamped by
+        # ``Replica.__init__`` the same way ``weight_version`` is by
+        # the pool, so events carry fleet identity. ``_trace_rid`` is
+        # the request whose prefill is on the device RIGHT NOW — the
+        # compile-watch tap below uses it to pin a recompile to the
+        # exact request that paid for it.
+        self.tracker = None
+        self.replica_name = None
+        self._trace_rid = None
         reg = default_registry() if registry is None else registry
         self._m_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot")
@@ -1547,6 +1558,10 @@ class ContinuousBatcher:
         self._decode_fn = self._watch.watch(
             lambda *a, **k: paged_decode(*a, **k),
             name="serving_decode")
+        # a NEW signature during a request's prefill = that request
+        # paid an XLA compile; land it on its timeline (no-op until
+        # the router wires a tracker)
+        self._watch.add_tap(self._compile_tap)
         # serving readiness: the load-balancer gate (/readyz)
         if health is None:
             from bigdl_tpu.observability.exporter import default_health
@@ -1557,6 +1572,19 @@ class ContinuousBatcher:
         self.health_name = str(health_name)
         self._health.register(self.health_name, self._ready,
                               kind="readiness")
+
+    # -- request timelines (tracker lock is a leaf; no-ops when off) --
+    def _tev(self, rid, event, **fields) -> None:
+        tr = self.tracker
+        if tr is not None:
+            tr.event(rid, event, replica=self.replica_name,
+                     weight_version=self.weight_version, **fields)
+
+    def _compile_tap(self, name: str, n_signatures: int) -> None:
+        rid = self._trace_rid
+        if rid is not None:
+            self._tev(rid, "compile", watch=name,
+                      signatures=n_signatures)
 
     def _ready(self):
         """Readiness = admitting: a free slot exists, or nothing is
@@ -1782,6 +1810,10 @@ class ContinuousBatcher:
             # prompt end; padding columns never write pages
             padded = np.ones((1, bucket), np.int32)
             padded[0, :len(prompt)] = prompt
+            self._tev(rid, "prefill_start", kind="full", bucket=bucket,
+                      prompt_len=len(prompt))
+            self._trace_rid = rid
+            t_p0 = time.monotonic()
             with trace.span("prefill", cat="serving", bucket=bucket,
                             prompt_len=len(prompt),
                             host_sync="first-token readback"):
@@ -1794,8 +1826,15 @@ class ContinuousBatcher:
                     **self._kernel_kw)
                 # deliberate sync: TTFT is DEFINED by this readback
                 tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
-            # TTFT = queue wait + prefill, closed by the readback above
-            self._m_ttft.observe(time.monotonic() - t_submit)
+            self._trace_rid = None
+            t_p1 = time.monotonic()
+            # TTFT = queue wait + prefill, closed by the readback above;
+            # the exemplar links the bucket to /requests/<id>
+            self._m_ttft.observe(t_p1 - t_submit, exemplar=str(rid))
+            self._tev(rid, "first_token", via="prefill")
+            self._tev(rid, "prefill_end",
+                      dur_s=round(t_p1 - t_p0, 9),
+                      queue_s=round(t_p0 - t_submit, 9))
             self._m_admit.inc()
             self.slots[slot] = (rid, list(prompt), [tok0])
             self.lengths[slot] = len(prompt)
@@ -1835,7 +1874,11 @@ class ContinuousBatcher:
         # TTFT for an adopted request is queue wait alone: its first
         # token arrived with the snapshot (prefill was paid elsewhere —
         # or skipped entirely on a prefix-cache hit)
-        self._m_ttft.observe(time.monotonic() - t_submit)
+        wait = time.monotonic() - t_submit
+        self._m_ttft.observe(wait, exemplar=str(rid))
+        self._tev(rid, "adopt", n_cached=snap.n_cached,
+                  queue_s=round(wait, 9))
+        self._tev(rid, "first_token", via="adopt")
         self._m_admit.inc()
         self._m_skips.inc()
         got = list(snap.emitted)
@@ -1870,6 +1913,10 @@ class ContinuousBatcher:
         bucket = min(self._bucket(len(suffix)), self.max_prompt)
         padded = np.ones((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
+        self._tev(rid, "prefill_start", kind="suffix", bucket=bucket,
+                  prompt_len=len(prompt), prefill_from=p)
+        self._trace_rid = rid
+        t_p0 = time.monotonic()
         with trace.span("suffix prefill", cat="serving", bucket=bucket,
                         prompt_len=len(prompt), prefill_from=p,
                         host_sync="first-token readback"):
@@ -1881,7 +1928,12 @@ class ContinuousBatcher:
                 **self._kernel_kw)
             # deliberate sync: TTFT is DEFINED by this readback
             tok0 = int(np.asarray(first)[0])  # jaxlint: disable=JX1
-        self._m_ttft.observe(time.monotonic() - t_submit)
+        self._trace_rid = None
+        t_p1 = time.monotonic()
+        self._m_ttft.observe(t_p1 - t_submit, exemplar=str(rid))
+        self._tev(rid, "first_token", via="suffix")
+        self._tev(rid, "prefill_end", dur_s=round(t_p1 - t_p0, 9),
+                  queue_s=round(t_p0 - t_submit, 9))
         self._m_admit.inc()
         self._m_suffix.inc()
         self.slots[slot] = (rid, list(prompt), [tok0])
@@ -1944,8 +1996,11 @@ class ContinuousBatcher:
         resubmit instead); raises KeyError for unknown ids."""
         for slot, s in enumerate(self.slots):
             if s is not None and s[0] == request_id:
+                t0 = time.monotonic()
                 snap = self._export_slot(slot)
                 self._release(slot)
+                self._tev(request_id, "export", n_cached=snap.n_cached,
+                          dur_s=round(time.monotonic() - t0, 9))
                 return snap
         raise KeyError(f"request {request_id!r} is not in flight")
 
@@ -1956,8 +2011,12 @@ class ContinuousBatcher:
         out = []
         for slot, s in enumerate(self.slots):
             if s is not None:
-                out.append((s[0], self._export_slot(slot)))
+                t0 = time.monotonic()
+                snap = self._export_slot(slot)
                 self._release(slot)
+                self._tev(s[0], "export", n_cached=snap.n_cached,
+                          dur_s=round(time.monotonic() - t0, 9))
+                out.append((s[0], snap))
         return out
 
     def pop_queued(self) -> list:
@@ -2027,6 +2086,7 @@ class ContinuousBatcher:
         self._done.append((rid, result))
         self._release(slot)
         self._m_retire.inc()
+        self._tev(rid, "retire", tokens=len(result))
         if self.on_complete is not None:
             # a crashing hook must not take the step loop down with it
             try:
@@ -2097,6 +2157,9 @@ class ContinuousBatcher:
         for i in range(self.max_batch):
             if self.slots[i] is None:
                 self.lengths[i] = 0
+        # a decode burst is batch-wide: its compiles attribute to no
+        # single request
+        self._trace_rid = None
         t0 = time.monotonic()
         with trace.span("decode burst", cat="serving", burst=burst,
                         active=len(active),
@@ -2109,12 +2172,25 @@ class ContinuousBatcher:
         dt = time.monotonic() - t0
         self._m_tok_lat.observe(dt / burst)
         self._m_tokens.inc(len(active) * burst)
+        # stall detection: a burst whose per-token latency blows past
+        # the tracker's threshold (stall_factor x the SLO per-token
+        # target) books the excess as stall seconds on every active
+        # request — the attribution component that separates "decode
+        # was busy" from "decode was stuck"
+        stall = 0.0
+        tr = self.tracker
+        if tr is not None:
+            th = tr.stall_threshold_s
+            if th != float("inf") and dt / burst > th:
+                stall = dt - th * burst
         self.lengths = np.asarray(new_len, np.int32).copy()
         for i in active:
             rid, prompt, got = self.slots[i]
             got.extend(int(t) for t in toks[i])
             self.last[i] = int(toks[i, -1])
             self.slots[i] = (rid, prompt, got)
+            self._tev(rid, "decode", tokens=burst, dur_s=round(dt, 9),
+                      stall_s=round(stall, 9))
             hit_eos = (self.eos_id is not None
                        and self.eos_id in got[:self.max_new])
             if hit_eos or len(got) >= self.max_new:

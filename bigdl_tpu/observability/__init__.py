@@ -22,6 +22,9 @@ Pillars:
   exports cost/memory analysis, and warns on recompile storms.
 - ``flight_recorder`` — :class:`FlightRecorder`, a bounded black-box
   ring that dumps a postmortem directory on abnormal exit.
+- ``request_trace``   — :class:`RequestTracker`, per-request serving
+  timelines with tail sampling: end-to-end latency attribution for
+  the router/replica plane (:func:`default_tracker`).
 
 HOST-ONLY CONTRACT: nothing in this package imports jax at module top
 level (jaxlint rule JX5 enforces it) and nothing here blocks on a
@@ -37,6 +40,9 @@ from bigdl_tpu.observability.exporter import (HealthCheck,
                                               MetricsServer,
                                               default_health)
 from bigdl_tpu.observability.flight_recorder import FlightRecorder
+from bigdl_tpu.observability.request_trace import (RequestTimeline,
+                                                   RequestTracker,
+                                                   default_tracker)
 from bigdl_tpu.observability.registry import (Counter, Gauge, Histogram,
                                               MetricRegistry,
                                               default_registry,
@@ -51,4 +57,5 @@ __all__ = ["trace", "Tracer", "Counter", "Gauge", "Histogram",
            "Summary", "TrainSummary", "ValidationSummary",
            "SummaryReader", "MetricsServer", "HealthCheck",
            "HealthRegistry", "default_health", "FlightRecorder",
+           "RequestTimeline", "RequestTracker", "default_tracker",
            "compile_watch"]

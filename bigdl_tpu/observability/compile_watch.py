@@ -163,6 +163,12 @@ class CompileWatch:
         self.storm_threshold = int(storm_threshold)
         self._lock = threading.Lock()
         self._names: dict[str, dict] = {}
+        # compile taps: fn(name, n_signatures) on every NEW signature,
+        # invoked OUTSIDE the ledger lock; errors swallowed (mirrors
+        # Tracer taps). The serving batcher rides one to attribute a
+        # compile to the request whose prefill triggered it
+        # (observability/request_trace.py).
+        self._taps: list = []
 
     # -- plumbing --
     def _reg(self):
@@ -176,6 +182,20 @@ class CompileWatch:
             from bigdl_tpu.observability.tracing import get_tracer
             return get_tracer()
         return self._tracer
+
+    # -- taps --
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(name, n_signatures)`` to every new-signature
+        (= compile) event. Tap errors are swallowed: observability
+        must never take down the loop."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
 
     def _entry(self, name: str) -> dict:
         e = self._names.get(name)
@@ -221,6 +241,11 @@ class CompileWatch:
                       labelnames=("name",)).set(n_sigs, name=name)
             self._trace().instant("compile", cat="compile_watch",
                                   watch=name, signatures=n_sigs)
+            for tap in list(self._taps):
+                try:
+                    tap(name, n_sigs)
+                except Exception:
+                    pass
         if storm:
             diff = _sig_diff(prev, signature)
             reg.counter("compile_watch_storms_total",

@@ -16,7 +16,12 @@ Endpoints (GET):
 - ``/metrics``        Prometheus text exposition of the registry.
 - ``/metrics.json``   the registry's ``dump()`` as JSON.
 - ``/trace``          Chrome trace JSON from the live tracer (open the
-  response body in ui.perfetto.dev).
+  response body in ui.perfetto.dev). Capped to the most recent
+  ``DEFAULT_TRACE_LAST`` events; ``?last=N`` overrides (``0`` = all).
+- ``/requests``       slowest-K retained request timelines (summaries)
+  plus in-flight requests, from the :class:`RequestTracker`
+  (``?k=N`` picks K; docs/OBSERVABILITY.md "Request timelines").
+- ``/requests/<id>``  ONE request's full timeline JSON (404 unknown).
 - ``/healthz``        liveness checks (process up + registered
   ``kind="liveness"`` checks) — 200 ok / 503 failing, JSON body.
 - ``/readyz``         readiness checks (``kind="readiness"``) — the
@@ -42,7 +47,12 @@ import json
 import threading
 
 __all__ = ["HealthCheck", "HealthRegistry", "default_health",
-           "MetricsServer"]
+           "MetricsServer", "DEFAULT_TRACE_LAST"]
+
+# /trace ships at most this many (most recent) tracer events unless
+# ?last= overrides — the ring defaults to 1M events and a live scrape
+# of a long run must stay bounded (?last=0 means "everything")
+DEFAULT_TRACE_LAST = 10_000
 
 
 class HealthCheck:
@@ -151,15 +161,20 @@ class MetricsServer:
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
-                 registry=None, tracer=None, health=None):
+                 registry=None, tracer=None, health=None, tracker=None):
         if registry is None:
             from bigdl_tpu.observability.registry import default_registry
             registry = default_registry()
         if tracer is None:
             from bigdl_tpu.observability.tracing import get_tracer
             tracer = get_tracer()
+        if tracker is None:
+            from bigdl_tpu.observability.request_trace import \
+                default_tracker
+            tracker = default_tracker()
         self.registry = registry
         self.tracer = tracer
+        self.tracker = tracker
         self.health = health if health is not None else default_health()
         self._host = host
         self._want_port = int(port)
@@ -214,8 +229,48 @@ class MetricsServer:
             return (200, "application/json",
                     self.registry.dump_json().encode("utf-8"))
         if path == "/trace":
+            last = DEFAULT_TRACE_LAST
+            if query:
+                from urllib.parse import parse_qs
+                raw = parse_qs(query).get("last", [""])[-1]
+                try:
+                    last = int(raw)
+                except ValueError:
+                    pass
+            # ?last=0 (or negative) lifts the cap: the postmortem-style
+            # full dump, explicitly requested
+            cap = last if last > 0 else None
             return (200, "application/json",
-                    json.dumps(self.tracer.to_dict()).encode("utf-8"))
+                    json.dumps(self.tracer.to_dict(last=cap))
+                    .encode("utf-8"))
+        if path == "/requests":
+            # slowest-K retained timelines (summaries), plus what is
+            # in flight right now and the tracker's sampling counters
+            k = 32
+            if query:
+                from urllib.parse import parse_qs
+                raw = parse_qs(query).get("k", [""])[-1]
+                try:
+                    k = int(raw)
+                except ValueError:
+                    pass
+            body = json.dumps(
+                {"slowest": self.tracker.slowest(k),
+                 "in_flight": self.tracker.inflight(),
+                 "stats": self.tracker.stats()},
+                sort_keys=True, default=repr).encode("utf-8")
+            return 200, "application/json", body
+        if path.startswith("/requests/"):
+            rid = path[len("/requests/"):]
+            tl = self.tracker.timeline(rid)
+            if tl is None:
+                return (404, "application/json",
+                        json.dumps({"error": "unknown request id",
+                                    "request_id": rid})
+                        .encode("utf-8"))
+            return (200, "application/json",
+                    json.dumps(tl, sort_keys=True, default=repr)
+                    .encode("utf-8"))
         if path in ("/healthz", "/readyz"):
             kind = "liveness" if path == "/healthz" else "readiness"
             # ?check=NAME[,NAME...] (repeatable) narrows the verdict to
@@ -235,6 +290,7 @@ class MetricsServer:
         if path in ("/", ""):
             body = ("bigdl_tpu telemetry plane\n"
                     "endpoints: /metrics /metrics.json /trace "
+                    "/requests /requests/<id> "
                     "/healthz /readyz\n").encode("utf-8")
             return 200, "text/plain; charset=utf-8", body
         return (404, "text/plain; charset=utf-8",

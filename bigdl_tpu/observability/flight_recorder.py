@@ -13,6 +13,9 @@ on abnormal exit, writes a self-contained postmortem directory:
       trace.json           the live tracer buffer (Chrome trace JSON)
       events.jsonl         the ring: last-N spans/instants/log records
       compile_watch.json   the compile ledger (recompile-storm evidence)
+      requests.jsonl       per-request timelines: in-flight first (the
+                           crash's victims), then the retained tail
+                           (observability/request_trace.py)
 
 ``install()`` arms process-level hooks — ``sys.excepthook`` (chained),
 ``SIGTERM`` (main thread only; the k8s eviction signal), and an
@@ -83,13 +86,14 @@ class FlightRecorder:
 
     def __init__(self, dir: str | None = None, max_events: int = 512,
                  *, registry=None, tracer=None, watch=None,
-                 logger_name: str = "bigdl_tpu"):
+                 tracker=None, logger_name: str = "bigdl_tpu"):
         self.dir = dir or default_postmortem_dir()
         self._ring: collections.deque = collections.deque(
             maxlen=int(max_events))
         self._registry = registry
         self._tracer = tracer
         self._watch = watch
+        self._tracker = tracker
         self._logger_name = logger_name
         self._lock = threading.Lock()
         self._installs = 0
@@ -117,6 +121,13 @@ class FlightRecorder:
             from bigdl_tpu.observability.compile_watch import default_watch
             return default_watch()
         return self._watch
+
+    def _get_tracker(self):
+        if self._tracker is None:
+            from bigdl_tpu.observability.request_trace import \
+                default_tracker
+            return default_tracker()
+        return self._tracker
 
     # -- recording --
     def record(self, kind: str, name: str, **fields) -> None:
@@ -253,7 +264,8 @@ class FlightRecorder:
                  lambda p: self._get_tracer().export(p)),
                 ("events.jsonl", self._write_events),
                 ("compile_watch.json",
-                 lambda p: _write_json(p, self._get_watch().table()))):
+                 lambda p: _write_json(p, self._get_watch().table())),
+                ("requests.jsonl", self._write_requests)):
             try:
                 writer(os.path.join(d, fname))
             except Exception as e:
@@ -267,6 +279,13 @@ class FlightRecorder:
         with open(path, "w", encoding="utf-8") as f:
             for ev in self.events():
                 f.write(json.dumps(ev, default=repr) + "\n")
+
+    def _write_requests(self, path: str) -> None:
+        # in-flight timelines first (the crash's victims), then the
+        # retained tail — one full timeline per line
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in self._get_tracker().to_records():
+                f.write(json.dumps(rec, default=repr) + "\n")
 
 
 def _write_json(path: str, obj) -> None:

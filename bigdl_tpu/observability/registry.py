@@ -31,6 +31,7 @@ import math
 import os
 import re
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
            "default_registry", "sanitize_name", "DEFAULT_BUCKETS"]
@@ -138,7 +139,14 @@ class Gauge(_Metric):
 
 class Histogram(_Metric):
     """Distribution with FIXED bucket boundaries (upper bounds,
-    cumulative in exposition; +Inf implicit)."""
+    cumulative in exposition; +Inf implicit).
+
+    ``observe(v, exemplar="rid-42")`` additionally remembers the
+    observation as the bucket's last EXEMPLAR — a trace id linking the
+    aggregate series back to one concrete request timeline
+    (``/requests/<id>``). Exemplars ride exposition OpenMetrics-style
+    (``... # {trace_id="rid-42"} 0.37 <unix ts>``) and ``dump()``;
+    ``snapshot()`` stays exemplar-free so merges are unchanged."""
 
     kind = "histogram"
 
@@ -152,7 +160,8 @@ class Histogram(_Metric):
                 f"finite upper bounds, got {buckets}")
         self.buckets = bs
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels):
         key = self._key(labels)
         v = float(value)
         with self._lock:
@@ -167,6 +176,14 @@ class Histogram(_Metric):
             st["counts"][i] += 1
             st["sum"] += v
             st["count"] += 1
+            if exemplar is not None:
+                # last exemplar per bucket index, created lazily so
+                # exemplar-free histograms carry zero extra state
+                ex = st.get("exemplars")
+                if ex is None:
+                    ex = st["exemplars"] = {}
+                ex[i] = {"trace_id": str(exemplar), "value": v,
+                         "ts": time.time()}
 
     def snapshot(self, **labels) -> dict:
         """Cumulative per-bucket counts plus sum/count:
@@ -251,13 +268,24 @@ class MetricRegistry:
             for key in sorted(series):
                 if isinstance(m, Histogram):
                     st = series[key]
+                    exemplars = st.get("exemplars") or {}
                     cum = 0
-                    for b, c in zip(m.buckets + (math.inf,),
-                                    st["counts"]):
+                    for i, (b, c) in enumerate(
+                            zip(m.buckets + (math.inf,),
+                                st["counts"])):
                         cum += c
                         lbl = m._labelstr(key,
                                           f'le="{_fmt(b)}"')
-                        lines.append(f"{m.name}_bucket{lbl} {cum}")
+                        ex = exemplars.get(i)
+                        tail = ""
+                        if ex is not None:
+                            # OpenMetrics exemplar syntax
+                            tail = (f' # {{trace_id="'
+                                    f'{_escape(ex["trace_id"])}"}} '
+                                    f'{_fmt(ex["value"])} '
+                                    f'{ex["ts"]:.3f}')
+                        lines.append(f"{m.name}_bucket{lbl} {cum}"
+                                     f"{tail}")
                     lines.append(f"{m.name}_sum{m._labelstr(key)} "
                                  f"{_fmt(st['sum'])}")
                     lines.append(f"{m.name}_count{m._labelstr(key)} "
@@ -283,10 +311,17 @@ class MetricRegistry:
                                     st["counts"]):
                         cum += c
                         buckets[_fmt(b)] = cum
-                    samples.append({"labels": labels,
-                                    "buckets": buckets,
-                                    "sum": float(st["sum"]),
-                                    "count": int(st["count"])})
+                    sample = {"labels": labels,
+                              "buckets": buckets,
+                              "sum": float(st["sum"]),
+                              "count": int(st["count"])}
+                    exemplars = st.get("exemplars")
+                    if exemplars:
+                        bounds = m.buckets + (math.inf,)
+                        sample["exemplars"] = {
+                            _fmt(bounds[i]): dict(ex)
+                            for i, ex in sorted(exemplars.items())}
+                    samples.append(sample)
                 else:
                     samples.append({"labels": labels,
                                     "value": float(series[key])})

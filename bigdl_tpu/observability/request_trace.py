@@ -1,0 +1,401 @@
+"""Per-request timelines: end-to-end tail-latency attribution.
+
+Aggregate histograms say the TTFT p99 breached; they cannot say where
+*that request's* time went. This module records one structured timeline
+per request across the whole serving path — router admission, pending
+park, prefix-cache outcome, placement, disaggregated handoff, batcher
+prefill (with compile events via a ``compile_watch`` tap), per-burst
+decode with stall detection, drain/migrate/requeue/orphan-restart, and
+retirement — so a slow request explains itself (docs/OBSERVABILITY.md
+"Request timelines"; the production-serving identity of the reference,
+arXiv:1804.05839, arXiv:2204.01715).
+
+Two classes:
+
+- :class:`RequestTimeline` — a bounded, thread-safe, monotonically
+  timestamped event list for ONE request. ``record()`` is a lock +
+  list append; attribution components (queue / prefill / decode /
+  stall / migration seconds) accumulate incrementally on recognized
+  event names, so a timeline that overflows its event bound keeps
+  exact attribution anyway (overflow drops events, never seconds).
+- :class:`RequestTracker` — the fleet-wide ledger with TAIL SAMPLING:
+  every in-flight request gets a full timeline (a crash dump must
+  explain its victims), but at retirement only the interesting tail is
+  retained in full — every SLO-violating or abnormally finished
+  request, the slowest-K of a rolling window, plus a deterministic
+  1-in-N sample of the fast majority. Everything else is dropped after
+  its seconds landed in the aggregate histograms (the router's
+  ``router_queue_wait_seconds`` is observed for EVERY request,
+  independent of sampling).
+
+Surfaces: ``MetricsServer`` ``/requests`` (slowest-K summaries) and
+``/requests/<id>`` (full timeline JSON); ``FlightRecorder``
+postmortems write ``requests.jsonl``; ``Router.latency_summary()``
+carries :meth:`RequestTracker.attribution`.
+
+Locking: the tracker lock is a strict LEAF — it guards only the
+tracker's own dicts and is never held across a call into any other
+component; timelines carry their own leaf lock and never call out at
+all. Holding either while acquiring a serving-plane lock is a
+raceguard TS1 failure (declarations below; the sanctioned nesting is
+the reverse — router/replica threads record events while holding
+their own locks).
+
+HOST-ONLY CONTRACT: never imports jax (jaxlint JX5); recording is a
+lock + dict/list update on host memory, safe at decode-burst
+frequency.
+"""
+# raceguard: order requesttracker.mu < state_lock < replica.lock
+# raceguard: order requesttimeline.mu < requesttracker.mu
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["RequestTimeline", "RequestTracker", "default_tracker",
+           "COMPONENTS"]
+
+# the attribution decomposition every timeline accumulates; summaries
+# and Router.latency_summary()["attribution"] key on these names
+COMPONENTS = ("queue_s", "prefill_s", "decode_s", "stall_s",
+              "migration_s")
+
+# events appended even when the timeline is at its event bound: losing
+# the terminal record would make a bounded timeline look in-flight
+_ALWAYS_KEEP = ("finish", "retire", "complete")
+
+
+class RequestTimeline:
+    """Bounded structured event list for one request (see module
+    docstring). Events are ``{"t": <monotonic seconds>, "event":
+    <name>, ...fields}``; ``t`` shares one clock across every emitting
+    thread, so router- and batcher-side events interleave in causal
+    order."""
+
+    def __init__(self, request_id, *, max_events: int = 256):
+        self.request_id = request_id
+        self._mu = threading.Lock()
+        self._max = int(max_events)
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._t0 = time.monotonic()
+        self._t_first_token: float | None = None
+        self._t_finish: float | None = None
+        self._status: str | None = None
+        self._tokens = 0
+        self._replicas: list = []
+        self._versions: list = []
+        self._components = dict.fromkeys(COMPONENTS, 0.0)
+        self.retained_reason: str | None = None
+
+    # -- recording --
+    def record(self, event: str, **fields) -> None:
+        """Append one event. Attribution components update even when
+        the event itself is dropped by the bound."""
+        t = time.monotonic()
+        with self._mu:
+            self._absorb(event, t, fields)
+            if len(self._events) >= self._max and \
+                    event not in _ALWAYS_KEEP:
+                self._dropped += 1
+                return
+            ev = {"t": round(t - self._t0, 9), "event": event}
+            ev.update(fields)
+            self._events.append(ev)
+
+    def _absorb(self, event: str, t: float, fields: dict) -> None:
+        """Component/identity accumulation (called under ``_mu``)."""
+        c = self._components
+        if event == "place":
+            wait = float(fields.get("wait_s") or 0.0)
+            if fields.get("cause") == "submit":
+                c["queue_s"] += wait
+            else:                   # requeue / restart re-placements
+                c["migration_s"] += wait
+        elif event in ("prefill_end", "adopt"):
+            c["prefill_s"] += float(fields.get("dur_s") or 0.0)
+            c["queue_s"] += float(fields.get("queue_s") or 0.0)
+        elif event == "decode":
+            c["decode_s"] += float(fields.get("dur_s") or 0.0)
+            c["stall_s"] += float(fields.get("stall_s") or 0.0)
+        elif event == "export":
+            c["migration_s"] += float(fields.get("dur_s") or 0.0)
+        elif event == "first_token":
+            if self._t_first_token is None:
+                self._t_first_token = t
+        elif event == "finish":
+            self._t_finish = t
+            self._status = str(fields.get("status", "ok"))
+        if event in ("retire", "complete"):
+            n = fields.get("tokens")
+            if n is not None:
+                self._tokens = max(self._tokens, int(n))
+        rep = fields.get("replica")
+        if rep is not None and rep not in self._replicas:
+            self._replicas.append(rep)
+        ver = fields.get("weight_version")
+        if ver is not None and ver not in self._versions:
+            self._versions.append(ver)
+
+    # -- views --
+    @property
+    def finished(self) -> bool:
+        return self._t_finish is not None
+
+    @property
+    def duration_s(self) -> float:
+        end = self._t_finish
+        return (time.monotonic() if end is None else end) - self._t0
+
+    @property
+    def ttft_s(self) -> float | None:
+        t = self._t_first_token
+        return None if t is None else t - self._t0
+
+    @property
+    def stalled(self) -> bool:
+        return self._components["stall_s"] > 0.0
+
+    def summary(self) -> dict:
+        """Compact per-request record (/requests rows)."""
+        with self._mu:
+            return {
+                "request_id": str(self.request_id),
+                "status": self._status or "in_flight",
+                "duration_s": self.duration_s,
+                "ttft_s": self.ttft_s,
+                "tokens": self._tokens,
+                "replicas": list(self._replicas),
+                "weight_versions": list(self._versions),
+                "components": dict(self._components),
+                "events": len(self._events),
+                "dropped_events": self._dropped,
+                "retained_reason": self.retained_reason,
+            }
+
+    def to_dict(self) -> dict:
+        """Full timeline (summary + every retained event)."""
+        with self._mu:
+            events = [dict(e) for e in self._events]
+        out = self.summary()
+        out["timeline"] = events
+        return out
+
+
+class RequestTracker:
+    """Fleet-wide request ledger with tail sampling (module
+    docstring). One process-wide instance lives behind
+    :func:`default_tracker`; components take ``tracker=`` to isolate.
+
+    Retention policy, decided at :meth:`finish` time:
+
+    - ``slo``      — TTFT over ``slo.ttft_p99_s``, any decode stall,
+      or a non-``"ok"`` status (shed / cancelled / failed): ALWAYS
+      retained.
+    - ``slowest``  — the request ranks in the slowest ``slowest_k`` of
+      the last ``window`` finished durations: retained.
+    - ``sampled``  — deterministic 1-in-``sample_every`` counter
+      sample of everything else (no RNG; reproducible in tests).
+
+    The retained ring is bounded (``max_retained``); the oldest tail
+    entries fall off first.
+    """
+
+    def __init__(self, *, slo=None, sample_every: int = 16,
+                 slowest_k: int = 8, window: int = 128,
+                 max_retained: int = 256, max_events: int = 256,
+                 stall_factor: float = 4.0):
+        if int(sample_every) < 1:
+            raise ValueError(f"sample_every must be >= 1, got "
+                             f"{sample_every}")
+        self.slo = slo
+        self.sample_every = int(sample_every)
+        self.slowest_k = int(slowest_k)
+        self.max_events = int(max_events)
+        self._stall_factor = float(stall_factor)
+        self._mu = threading.Lock()
+        self._live: dict = {}                 # rid -> RequestTimeline
+        self._retained: deque = deque(maxlen=int(max_retained))
+        self._window: deque = deque(maxlen=int(window))
+        self._started = 0
+        self._finished = 0
+        self._sample_count = 0
+        self._retained_by: dict[str, int] = {"slo": 0, "slowest": 0,
+                                             "sampled": 0}
+
+    # -- thresholds the batcher reads (host-side, lock-free) --
+    @property
+    def ttft_slo_s(self) -> float:
+        return float(self.slo.ttft_p99_s) if self.slo is not None \
+            else float("inf")
+
+    @property
+    def stall_threshold_s(self) -> float:
+        """Per-token decode latency past which a burst counts as a
+        stall: ``stall_factor`` x the SLO per-token target (a stall is
+        a pathological burst, not a p99 grazer)."""
+        if self.slo is None:
+            return float("inf")
+        return self._stall_factor * float(self.slo.decode_token_p99_s)
+
+    # -- recording --
+    def begin(self, request_id, **fields) -> RequestTimeline:
+        """Open (or return the already-open) timeline for
+        ``request_id`` and record its ``submit`` event. Idempotent:
+        a requeued/migrated request keeps its ONE timeline."""
+        with self._mu:
+            tl = self._live.get(request_id)
+            fresh = tl is None
+            if fresh:
+                tl = RequestTimeline(request_id,
+                                     max_events=self.max_events)
+                self._live[request_id] = tl
+                self._started += 1
+        if fresh:
+            tl.record("submit", **fields)
+        return tl
+
+    def event(self, request_id, event: str, **fields) -> bool:
+        """Record one event onto the live timeline; False (dropped)
+        for unknown/already-finished ids."""
+        with self._mu:
+            tl = self._live.get(request_id)
+        if tl is None:
+            return False
+        tl.record(event, **fields)
+        return True
+
+    def finish(self, request_id, *, status: str = "ok") -> dict | None:
+        """Seal the timeline, decide retention, return its summary
+        (None for unknown ids). Exactly-once: the first finish wins;
+        later calls are no-ops."""
+        with self._mu:
+            tl = self._live.pop(request_id, None)
+        if tl is None:
+            return None
+        tl.record("finish", status=status)
+        dur = tl.duration_s
+        ttft = tl.ttft_s
+        slo_violated = (status != "ok" or tl.stalled
+                        or (ttft is not None
+                            and ttft > self.ttft_slo_s))
+        with self._mu:
+            self._finished += 1
+            window = sorted(self._window, reverse=True)
+            kth = window[self.slowest_k - 1] \
+                if len(window) >= self.slowest_k else 0.0
+            self._window.append(dur)
+            reason = None
+            if slo_violated:
+                reason = "slo"
+            elif dur >= kth or len(window) < self.slowest_k:
+                reason = "slowest"
+            else:
+                self._sample_count += 1
+                if self._sample_count % self.sample_every == 0:
+                    reason = "sampled"
+            if reason is not None:
+                tl.retained_reason = reason
+                self._retained_by[reason] += 1
+                self._retained.append(tl)
+        return tl.summary()
+
+    # -- views --
+    def inflight(self) -> list[dict]:
+        with self._mu:
+            live = list(self._live.values())
+        return [tl.summary() for tl in live]
+
+    def retained(self) -> list["RequestTimeline"]:
+        with self._mu:
+            return list(self._retained)
+
+    def slowest(self, k: int = 32) -> list[dict]:
+        """Slowest-k retained summaries, slowest first (the
+        ``/requests`` body)."""
+        out = [tl.summary() for tl in self.retained()]
+        out.sort(key=lambda s: s["duration_s"], reverse=True)
+        return out[:max(int(k), 0)]
+
+    def timeline(self, request_id) -> dict | None:
+        """Full timeline for a live or retained id (``/requests/<id>``;
+        retained ids may repeat — the newest wins)."""
+        rid = str(request_id)
+        with self._mu:
+            tl = self._live.get(request_id)
+            if tl is None:        # ids over HTTP arrive as strings
+                for cand in self._live.values():
+                    if str(cand.request_id) == rid:
+                        tl = cand
+                        break
+            if tl is None:
+                for cand in reversed(self._retained):
+                    if str(cand.request_id) == rid:
+                        tl = cand
+                        break
+        return None if tl is None else tl.to_dict()
+
+    def attribution(self) -> dict:
+        """Where the tail's time went: the retained requests at or
+        above the p99 duration (always at least the slowest one)
+        decomposed into mean per-request component seconds and
+        fractions. Components need not sum to the duration (untracked
+        time shows up as a fraction gap, which is itself a signal)."""
+        tails = self.retained()
+        if not tails:
+            return {"requests": 0, "tail_requests": 0,
+                    "p99_duration_s": None, "components": {},
+                    "fractions": {}}
+        durs = sorted(tl.duration_s for tl in tails)
+        p99 = durs[max(0, min(len(durs) - 1,
+                              int(round(0.99 * (len(durs) - 1)))))]
+        tail = [tl for tl in tails if tl.duration_s >= p99] or \
+            [max(tails, key=lambda tl: tl.duration_s)]
+        comp = dict.fromkeys(COMPONENTS, 0.0)
+        total = 0.0
+        for tl in tail:
+            s = tl.summary()
+            total += s["duration_s"]
+            for k in COMPONENTS:
+                comp[k] += s["components"][k]
+        n = len(tail)
+        return {
+            "requests": len(tails),
+            "tail_requests": n,
+            "p99_duration_s": p99,
+            "components": {k: v / n for k, v in comp.items()},
+            "fractions": {k: (v / total if total > 0 else 0.0)
+                          for k, v in comp.items()},
+        }
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "started": self._started,
+                "finished": self._finished,
+                "in_flight": len(self._live),
+                "retained": len(self._retained),
+                "retained_by": dict(self._retained_by),
+                "sampled_out": (self._sample_count
+                                - self._retained_by["sampled"]),
+            }
+
+    def to_records(self) -> list[dict]:
+        """Full timelines for postmortems (``requests.jsonl``):
+        in-flight first (the crash's victims), then the retained tail,
+        newest last."""
+        with self._mu:
+            live = list(self._live.values())
+            kept = list(self._retained)
+        return [tl.to_dict() for tl in live] + \
+            [tl.to_dict() for tl in kept]
+
+
+_DEFAULT = RequestTracker()
+
+
+def default_tracker() -> RequestTracker:
+    """The process-wide tracker (pass ``tracker=`` to instrumented
+    components to isolate — tests construct their own)."""
+    return _DEFAULT

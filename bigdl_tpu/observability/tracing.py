@@ -160,13 +160,24 @@ class Tracer:
         self.instant(what, cat="host_sync", **args)
 
     # -- export --
-    def to_dict(self) -> dict:
+    def to_dict(self, last: int | None = None) -> dict:
+        """Chrome trace JSON. ``last=N`` keeps only the N most recent
+        events (the exporter's ``/trace?last=`` cap — a live scrape of
+        a long run must not ship the whole 1M-event ring); elided
+        events are reported in ``otherData.elided_events``."""
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        return {"traceEvents": events, "displayTimeUnit": "ms",
-                "otherData": {"dropped_events": dropped,
-                              "clock": "monotonic_us"}}
+        elided = 0
+        if last is not None and len(events) > max(int(last), 0):
+            elided = len(events) - max(int(last), 0)
+            events = events[elided:]
+        out = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"dropped_events": dropped,
+                             "clock": "monotonic_us"}}
+        if elided:
+            out["otherData"]["elided_events"] = elided
+        return out
 
     def export(self, path: str) -> str:
         """Write Chrome trace JSON; open in chrome://tracing or
@@ -225,8 +236,8 @@ def export(path: str) -> str:
     return _TRACER.export(path)
 
 
-def to_dict() -> dict:
-    return _TRACER.to_dict()
+def to_dict(last: int | None = None) -> dict:
+    return _TRACER.to_dict(last=last)
 
 
 def clear():

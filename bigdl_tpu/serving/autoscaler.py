@@ -116,13 +116,16 @@ class FleetView:
     """One evaluation's frozen inputs: per-replica
     :class:`~bigdl_tpu.serving.slo.ReplicaStats`, the fleet-merged
     TTFT / decode-token histogram snapshots for the window (already
-    windowed deltas when the :class:`Autoscaler` built them), and the
-    router's pending-queue depth."""
+    windowed deltas when the :class:`Autoscaler` built them), the
+    router's pending-queue depth, and — when the router exposes it —
+    the windowed ``router_queue_wait_seconds`` snapshot (the TTFT
+    component the per-replica clocks cannot see)."""
 
     replicas: tuple
     ttft: dict
     decode: dict
     pending: int = 0
+    queue_wait: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,10 +170,15 @@ def decide(view: FleetView, *, config: AutoscalerConfig,
     queued = sum(s.queue_depth for s in live)
     slots = sum(s.active_slots + s.free_slots for s in live)
     busy = (sum(s.active_slots for s in live) / slots) if slots else 0.0
+    qwait_p99 = (percentile(view.queue_wait, 0.99)
+                 if view.queue_wait else None)
     signals = {
         "ttft_p99_s": ttft_p99, "decode_token_p99_s": dec_p99,
         "pending": int(view.pending), "queued": queued,
         "kv_utilization_max": kv_max, "busy_fraction": busy,
+        # observed, not (yet) acted on: the router-side wait rides the
+        # decision log so a pending-driven scale-up can be attributed
+        "queue_wait_p99_s": qwait_p99,
     }
 
     breaches = []
@@ -279,6 +287,7 @@ class Autoscaler:
         self._low_streak = 0
         self._cooldown = 0
         self._prev: dict = {}     # replica -> metric -> last snapshot
+        self._prev_qwait: dict | None = None  # router queue-wait window
         self._eval_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -330,10 +339,23 @@ class Autoscaler:
                 last and last.get(_LATENCY_METRICS[1])))
             prev_next[rep.name] = cur
         self._prev = prev_next    # removed replicas fall out here
+        # router-level queue wait, same windowing (getattr-guarded so
+        # test doubles without the method keep working)
+        qwait = None
+        snap_fn = getattr(self.router, "queue_wait_snapshot", None)
+        if callable(snap_fn):
+            try:
+                cur_q = snap_fn()
+            except Exception:
+                cur_q = None
+            if cur_q is not None:
+                qwait = _delta_snapshot(cur_q, self._prev_qwait)
+                self._prev_qwait = cur_q
         return FleetView(replicas=tuple(stats),
                          ttft=merge_snapshots(ttft),
                          decode=merge_snapshots(dec),
-                         pending=self.router.pending_count)
+                         pending=self.router.pending_count,
+                         queue_wait=qwait)
 
     # -- the loop body --
     def evaluate(self) -> Decision:

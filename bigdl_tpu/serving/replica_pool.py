@@ -64,6 +64,11 @@ class Replica:
                  health=None, poll_interval: float = 0.005):
         self.name = str(name)
         self.batcher = batcher
+        # stamp our name onto the batcher so its request-timeline
+        # events (observability/request_trace.py) carry the replica
+        # identity — the same post-construction idiom as
+        # ``batcher.weight_version``
+        batcher.replica_name = self.name
         self.registry = registry
         self.lock = threading.RLock()
         self._burst = burst

@@ -34,6 +34,21 @@ Results fan in through the batchers' ``on_complete`` hooks into one
 ``finished()`` stream; every accepted request completes exactly once
 (no drops, no duplicates — test-pinned).
 
+Every accepted request also carries a per-request TIMELINE
+(observability/request_trace.py): admission, pending park,
+prefix-cache outcome, placement, disaggregated handoff, migration and
+completion land as structured events on ONE timeline that follows the
+request across replicas and weight versions; the tracker tail-samples
+at completion so only the interesting tail is retained in full. The
+router-side wait (submit -> replica placement, admission plus any
+pending park) is observed into ``router_queue_wait_seconds`` for
+EVERY request — the component the batcher's TTFT clock cannot see —
+with the request id attached to its histogram bucket as an
+OpenMetrics exemplar, so a breached ``/metrics`` bucket links
+straight to ``/requests/<id>``. ``latency_summary()`` carries the
+queue-wait percentiles and the tracker's tail attribution
+(docs/SERVING.md "diagnosing a slow request").
+
 Locking: ``_state_lock`` guards only the router's own dicts and is
 never held while a replica lock is being acquired; replica driver
 threads call back into ``_on_complete`` holding their replica lock and
@@ -41,7 +56,10 @@ take ``_state_lock`` briefly, and the prefix-capture hook takes the
 prefix cache's internal lock the same way (replica -> prefixcache).
 The dispatch path queries the cache BEFORE touching any replica lock,
 so ``prefixcache._lock`` nests strictly inside ``replica.lock`` and
-never the reverse. Those one-way orders are what make the plane
+never the reverse. The request tracker's lock is a strict LEAF inside
+all of them: timeline events are recorded while ``_state_lock`` or a
+replica lock is held, and the tracker never calls back into the
+serving plane. Those one-way orders are what make the plane
 deadlock-free, and the declaration below turns them into a
 machine-checked gate (dev/analysis/raceguard.py TS1): acquiring
 ``replica.lock`` anywhere while ``state_lock`` or the cache lock is
@@ -51,6 +69,7 @@ dispatcher thread, so batcher-level arrival order is preserved.
 HOST-ONLY CONTRACT: never imports jax (jaxlint JX5) — routing is pure
 host orchestration over the batcher API.
 """
+# raceguard: order requesttracker.mu < state_lock
 # raceguard: order state_lock < prefixcache._lock < replica.lock
 from __future__ import annotations
 
@@ -61,6 +80,7 @@ from collections import deque
 from bigdl_tpu.observability import trace
 from bigdl_tpu.observability.exporter import default_health
 from bigdl_tpu.observability.registry import default_registry
+from bigdl_tpu.observability.request_trace import default_tracker
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.slo import (SLOConfig, admissible, load_score,
                                    merge_snapshots, percentile)
@@ -91,9 +111,20 @@ class Router:
     def __init__(self, pool, *, slo: SLOConfig | None = None,
                  prefix_cache: PrefixCache | None = None,
                  registry=None, health=None, prefill_replica=None,
-                 capture_prefixes: bool = True):
+                 capture_prefixes: bool = True, tracker=None):
         self.pool = pool
         self.slo = slo if slo is not None else SLOConfig()
+        # tracker=None -> the process-wide default; tracker=False ->
+        # timelines off (queue-wait histogram still observed)
+        if tracker is False:
+            self._tracker = None
+        else:
+            self._tracker = (tracker if tracker is not None
+                             else default_tracker())
+        if self._tracker is not None and self._tracker.slo is None:
+            # teach the default tracker this fleet's SLO so retention
+            # (ttft > slo) and stall thresholds mean something
+            self._tracker.slo = self.slo
         self.prefix = (prefix_cache if prefix_cache is not None
                        else PrefixCache())
         self._capture = bool(capture_prefixes)
@@ -147,6 +178,11 @@ class Router:
             "router_replica_kv_utilization",
             "per-replica KV page utilization as last seen by the router",
             labelnames=("replica",))
+        self._m_qwait = reg.histogram(
+            "router_queue_wait_seconds",
+            "seconds between submit() and replica placement (admission "
+            "+ pending park) — the TTFT component the batcher clock "
+            "cannot see; observed for EVERY accepted request")
 
         self._health = health if health is not None else default_health()
         self._health.register("serving_router", self._ready,
@@ -156,6 +192,7 @@ class Router:
         # replica lock (see module docstring)
         self._state_lock = threading.Lock()
         self._inflight: dict = {}       # rid -> replica name | None
+        self._enq: dict = {}            # rid -> (t_monotonic, cause)
         self._pending: deque = deque()  # (rid, payload, session)
         self._results: deque = deque()
         self._sessions: dict = {}       # session id -> replica name
@@ -178,6 +215,7 @@ class Router:
 
         for name, rep in pool.replicas.items():
             rep.batcher.on_complete = self._make_on_complete(name)
+            rep.batcher.tracker = self._tracker
             if self._capture:
                 rep.batcher.on_prefill = self._make_on_prefill(name)
 
@@ -186,6 +224,15 @@ class Router:
             target=self._pump, name="bigdl-serving-router", daemon=True)
         self._pump_thread.start()
 
+    # -- request timelines (tracker lock is a leaf; no-ops when off) --
+    def _tev(self, rid, event, **fields) -> None:
+        if self._tracker is not None:
+            self._tracker.event(rid, event, **fields)
+
+    def _t_finish(self, rid, status: str = "ok") -> None:
+        if self._tracker is not None:
+            self._tracker.finish(rid, status=status)
+
     # -- hooks (run on replica driver threads, replica lock held) --
     def _make_on_complete(self, name):
         def hook(rid, toks):
@@ -193,6 +240,8 @@ class Router:
                 self._inflight.pop(rid, None)
                 self._results.append((rid, list(toks)))
             self._m_completed.inc()
+            self._tev(rid, "complete", replica=name, tokens=len(toks))
+            self._t_finish(rid)
             tap = self.on_result
             if tap is not None:
                 try:
@@ -254,24 +303,33 @@ class Router:
                     f"duplicate request_id {request_id!r}: still "
                     "pending or in flight")
             self._inflight[request_id] = None    # reserve
+            self._enq[request_id] = (time.monotonic(), "submit")
         self._m_requests.inc()
+        if self._tracker is not None:
+            self._tracker.begin(request_id, prompt_len=len(prompt))
         try:
             placed = self._dispatch(request_id, prompt, session)
         except Exception:
             with self._state_lock:
                 self._inflight.pop(request_id, None)
+                self._enq.pop(request_id, None)
+            self._t_finish(request_id, "error")
             raise
         if placed is None:
             with self._state_lock:
                 if len(self._pending) >= self.slo.max_pending:
                     self._inflight.pop(request_id, None)
+                    self._enq.pop(request_id, None)
                     self._m_rejected.inc()
+                    self._t_finish(request_id, "shed")
                     raise RouterSaturated(
                         f"no replica admits and {len(self._pending)} "
                         f"requests already pending "
                         f"(slo.max_pending={self.slo.max_pending})")
                 self._pending.append((request_id, prompt, session))
                 self._m_pending.set(len(self._pending))
+                depth = len(self._pending)
+            self._tev(request_id, "park", depth=depth)
         # counted once per ACCEPTED request (after the shed gate), so
         # the tokens-reused fraction has a clean denominator even when
         # pending work is re-dispatched several times
@@ -296,11 +354,14 @@ class Router:
                     del self._pending[i]
                     self._m_pending.set(len(self._pending))
                     self._inflight.pop(request_id, None)
+                    self._enq.pop(request_id, None)
+                    self._t_finish(request_id, "cancelled")
                     return True
             owner = self._inflight.get(request_id)
         if owner is not None and self.pool[owner].cancel(request_id):
             with self._state_lock:
                 self._inflight.pop(request_id, None)
+            self._t_finish(request_id, "cancelled")
             return True
         return False
 
@@ -344,6 +405,13 @@ class Router:
             # attributable to exactly ONE (the current) version, and
             # the request still completes exactly once
             self._m_restarts.inc()
+            self._tev(rid, "orphan_restart",
+                      weight_version=getattr(payload, "weight_version",
+                                             None))
+            with self._state_lock:
+                # this wait attributes to migration, not admission
+                if rid in self._enq:
+                    self._enq[rid] = (self._enq[rid][0], "restart")
             payload = list(payload.prompt)
             is_prompt = True
         stats = self._fleet_stats()
@@ -356,6 +424,10 @@ class Router:
             # survivors are exactly that set)
             cands = [s for s in cands
                      if self._version_ok(payload, s.name)]
+        if cands:
+            # emitted only when something admits: a parked request's
+            # retry loop must not spam its timeline every flush tick
+            self._tev(rid, "route", candidates=len(cands))
         with trace.span("route", cat="serving",
                         prompt_len=len(payload) if is_prompt else
                         len(payload.prompt),
@@ -380,6 +452,9 @@ class Router:
                             self.pool[target].submit(rid, snapshot=snap)
                             self._m_prefix_hits.inc()
                             self._m_tokens_reused.inc(len(payload))
+                            self._tev(rid, "prefix_cache",
+                                      outcome="exact",
+                                      tokens_reused=len(payload))
                             self._place(rid, target, session)
                             return target
                         placed = self._adopt_partial(
@@ -399,6 +474,8 @@ class Router:
                 return None
             target = self._pick(cands, session)
             if is_prompt:
+                self._tev(rid, "prefix_cache", outcome="miss",
+                          tokens_reused=0)
                 self.pool[target].submit(rid, payload)
             else:
                 self.pool[target].submit(rid, snapshot=payload)
@@ -430,6 +507,8 @@ class Router:
             return None           # transient refusal -> fresh prefill
         self._m_prefix_partial.inc()
         self._m_tokens_reused.inc(trunc.n_cached)
+        self._tev(rid, "prefix_cache", outcome="partial",
+                  tokens_reused=trunc.n_cached)
         self._place(rid, target, session)
         return target
 
@@ -446,12 +525,23 @@ class Router:
             self._inflight[rid] = target
             if session is not None:
                 self._sessions[session] = target
+            enq = self._enq.pop(rid, None)
+        if enq is not None:
+            # the common success point for EVERY placement path: exact
+            # / partial adopt, disaggregated, plain, and requeued work.
+            # The exemplar ties the bucket to /requests/<id>.
+            t_enq, cause = enq
+            wait = time.monotonic() - t_enq
+            self._m_qwait.observe(wait, exemplar=str(rid))
+            self._tev(rid, "place", replica=target, cause=cause,
+                      wait_s=round(wait, 9))
 
     def _dispatch_disaggregated(self, rid, prompt, session, stats,
                                 cands):
         """Prefill on the designated/lowest-load replica, decode on the
         best OTHER candidate — a long prompt never parks a decode
         replica's bursts behind its prefill."""
+        self._tev(rid, "prefix_cache", outcome="miss", tokens_reused=0)
         names = {s.name for s in cands}
         if self._prefill_name is not None and self._prefill_name in names:
             pre = self._prefill_name
@@ -463,6 +553,7 @@ class Router:
             self._place(rid, pre, session)
             return pre
         dec = self._pick(decode_cands, session)
+        t_pre = time.monotonic()
         try:
             with trace.span("disagg prefill", cat="serving",
                             prefill=pre, decode=dec,
@@ -475,7 +566,19 @@ class Router:
             self.pool[target].submit(rid, prompt)
             self._place(rid, target, session)
             return target
+        pre_dur = time.monotonic() - t_pre
         self._m_disagg.inc()
+        self._tev(rid, "disagg", prefill=pre, decode=dec)
+        self._tev(rid, "prefill_end", kind="disagg", replica=pre,
+                  dur_s=round(pre_dur, 9))
+        with self._state_lock:
+            # the synchronous disagg prefill is prefill time, not
+            # queue wait: push the enqueue clock past it so the place
+            # event's wait_s (and router_queue_wait_seconds) measure
+            # only admission + park
+            if rid in self._enq:
+                t_enq, cause = self._enq[rid]
+                self._enq[rid] = (t_enq + pre_dur, cause)
         if self._capture:
             # long prompts are exactly the ones worth retaining
             self.prefix.put(prompt, dec, snap)
@@ -562,6 +665,7 @@ class Router:
             rep.drain_begin()
             requeued = rep.pop_queued()
             for rid, payload in requeued:
+                self._tev(rid, "requeue", from_replica=name)
                 self._requeue(rid, payload)
             migrated = []
             if policy is not None:
@@ -571,7 +675,10 @@ class Router:
                     snap = rep.export_request(rid)
                     migrated.append((rid, snap))
                     self._m_migrated.inc()
-                    self._requeue(rid, snap)
+                    self._tev(rid, "migrate", from_replica=name,
+                              weight_version=getattr(
+                                  snap, "weight_version", None))
+                    self._requeue(rid, snap, cause="migrate")
                 if not rep.wait_idle(timeout):
                     raise TimeoutError(
                         f"replica {name} did not finish its kept "
@@ -580,7 +687,10 @@ class Router:
                 migrated = rep.export_requests()
                 for rid, snap in migrated:
                     self._m_migrated.inc()
-                    self._requeue(rid, snap)
+                    self._tev(rid, "migrate", from_replica=name,
+                              weight_version=getattr(
+                                  snap, "weight_version", None))
+                    self._requeue(rid, snap, cause="migrate")
             elif not rep.wait_idle(timeout):
                 raise TimeoutError(
                     f"replica {name} did not drain in {timeout}s")
@@ -594,9 +704,10 @@ class Router:
         return {"replica": name, "requeued": len(requeued),
                 "migrated": len(migrated)}
 
-    def _requeue(self, rid, payload) -> None:
+    def _requeue(self, rid, payload, *, cause: str = "requeue") -> None:
         with self._state_lock:
             self._inflight[rid] = None
+            self._enq[rid] = (time.monotonic(), cause)
             self._pending.append((rid, payload, None))
             self._m_pending.set(len(self._pending))
 
@@ -611,6 +722,7 @@ class Router:
         spills onto the new capacity immediately. Idempotent."""
         rep = self.pool[name]
         rep.batcher.on_complete = self._make_on_complete(name)
+        rep.batcher.tracker = self._tracker
         if self._capture:
             rep.batcher.on_prefill = self._make_on_prefill(name)
         self._pump_wake.set()
@@ -625,12 +737,16 @@ class Router:
         dec = merge_snapshots(
             r.histogram_snapshot("serving_decode_token_seconds")
             for r in self.pool if r.name not in self._quarantined)
+        qw = self._m_qwait.snapshot()
         return {
             "ttft_p50_s": percentile(ttft, 0.5),
             "ttft_p99_s": percentile(ttft, 0.99),
             "ttft_count": ttft["count"],
             "decode_token_p50_s": percentile(dec, 0.5),
             "decode_token_p99_s": percentile(dec, 0.99),
+            "queue_wait_p50_s": percentile(qw, 0.5),
+            "queue_wait_p99_s": percentile(qw, 0.99),
+            "queue_wait_count": qw["count"],
             "prefix_hits": int(self._m_prefix_hits.value()),
             "prefix_partial_hits": int(self._m_prefix_partial.value()),
             "prefix_tokens_reused": int(self._m_tokens_reused.value()),
@@ -638,7 +754,18 @@ class Router:
                 self._m_tokens_reused.value()
                 / max(1.0, self._m_prompt_tokens.value())),
             "disagg_prefills": int(self._m_disagg.value()),
+            # where the retained tail's time went (None with the
+            # tracker disabled) — docs/SERVING.md's runbook entry point
+            "attribution": (self._tracker.attribution()
+                            if self._tracker is not None else None),
         }
+
+    def queue_wait_snapshot(self) -> dict:
+        """The router-level ``router_queue_wait_seconds`` histogram as
+        a mergeable snapshot (``slo.percentile``-ready). The autoscaler
+        scrapes this alongside per-replica TTFT so scale-out decisions
+        see the queue-wait component TTFT cannot."""
+        return self._m_qwait.snapshot()
 
     # -- lifecycle --
     def close(self, timeout: float = 10.0) -> None:

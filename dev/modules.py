@@ -31,7 +31,8 @@ MODULES = {
                "tests/test_generate.py", "tests/test_rnn_generate.py",
                "tests/test_serving.py", "tests/test_perf_paths.py"],
     "observability": ["tests/test_observability.py",
-                      "tests/test_telemetry.py"],
+                      "tests/test_telemetry.py",
+                      "tests/test_request_trace.py"],
     "tuning": ["tests/test_tuning.py"],
     "elastic": ["tests/test_elastic.py"],
     "serving": ["tests/test_serving_router.py",

@@ -1272,3 +1272,58 @@ class TestPrefixReuseRow:
         assert row["partial_hits"] > 0
         assert row["tokens_reused_fraction"] >= 0.5
         assert row["first_tokens_match"] is True
+
+
+class TestRequestTraceRow:
+    """ISSUE 19: request_trace_overhead — tracker-ON vs tracker-OFF
+    p50 TTFT ratio plus the induced queue-delay attribution drill —
+    rides the standard row/known/all contract. Lower is better and
+    the gate knows."""
+
+    FAKE = {"metric": "request_trace_overhead", "value": 1.01,
+            "unit": "x (tracker-ON p50 TTFT / tracker-OFF)",
+            "ttft_p50_on_s": 0.0202, "ttft_p50_off_s": 0.02,
+            "ttft_p99_on_s": 0.031, "ttft_p99_off_s": 0.03,
+            "within_overhead_budget": True, "timelines": 11,
+            "retained": 11, "drill_queue_fraction": 0.91,
+            "drill_queue_attributed": True, "drill_delay_s": 0.3,
+            "n_requests": 10}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_request_trace_overhead",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "request_trace_overhead",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "request_trace_overhead"
+        assert lines[-1]["rows"][0]["value"] == 1.01
+        with open(out) as f:
+            assert "bench_request_trace_overhead 1.01" in f.read()
+
+    def test_row_in_all_and_gate_direction(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "request_trace_overhead" in \
+            [r["metric"] for r in agg["rows"]]
+        # timelines making TTFT slower is the regression
+        assert "request_trace_overhead" in bench._GATE_LOWER_IS_BETTER
+
+    @pytest.mark.slow
+    def test_real_probe_attributes_queue_wait(self):
+        """The REAL drill (tiny geometry): with the replica driver
+        held for an induced delay, the tracker's tail attribution must
+        put >= 80% of the time on queue wait, and tracking every
+        timeline must stay within the 5% TTFT overhead budget."""
+        row = bench.bench_request_trace_overhead(
+            n_requests=6, max_new=4, d_model=32, num_layers=2)
+        assert row["metric"] == "request_trace_overhead"
+        assert row["value"] > 0
+        assert row["drill_queue_fraction"] >= 0.8
+        assert row["timelines"] == row["retained"] == 7

@@ -43,6 +43,7 @@ from bigdl_tpu.models.transformer.generate import (GenerationConfig,
 from bigdl_tpu.models.transformer.serving import ContinuousBatcher
 from bigdl_tpu.observability.exporter import HealthRegistry
 from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.observability.request_trace import RequestTracker
 from bigdl_tpu.serving import (PrefixCache, ReplicaPool, Router,
                                SLOConfig)
 from bigdl_tpu.serving.quantized import (dequantize_params,
@@ -344,6 +345,49 @@ class TestVersionSkew:
             assert sorted(res) == ["or"]          # exactly once
             np.testing.assert_array_equal(res["or"], new)
             assert reg.get("router_version_restarts_total").value() == 1
+        finally:
+            router.close()
+            pool.close()
+
+    def test_orphan_restart_keeps_one_timeline_across_versions(
+            self, model, model2):
+        """ISSUE 19: the orphan-restart drill leaves ONE request
+        timeline spanning BOTH weight versions — the restart is an
+        event on the same timeline (with the orphaned version named),
+        never a second submit or a forked finish."""
+        geo = dict(GEO, max_new_tokens=12, max_burst=2)
+        tracker = RequestTracker(sample_every=1)
+        health, reg, pool, router = _plane(model, geo=geo,
+                                           weight_version="v1",
+                                           tracker=tracker)
+        try:
+            p = _prompts([10], seed=19)[0]
+            router.drain("r1", timeout=60)
+            r0 = pool["r0"]
+            with r0.lock:
+                assert router.submit("or", p) == "r0"
+                r0.batcher.step(burst=2)
+                snap = r0.export_request("or")
+                r0.set_weights(model2, weight_version="v2")
+                pool["r1"].set_weights(model2, weight_version="v2")
+                router.resume("r1")
+                router._requeue("or", snap)
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == ["or"]          # exactly once
+            st = tracker.stats()
+            assert (st["started"], st["finished"]) == (1, 1)
+            tl = tracker.timeline("or")
+            names = [e["event"] for e in tl["timeline"]]
+            assert names.count("submit") == 1
+            assert names.count("finish") == 1
+            restarts = [e for e in tl["timeline"]
+                        if e["event"] == "orphan_restart"]
+            assert len(restarts) == 1
+            assert restarts[0]["weight_version"] == "v1"
+            # the one timeline names both versions it ran under
+            assert tl["weight_versions"] == ["v1", "v2"]
+            assert tl["status"] == "ok"
         finally:
             router.close()
             pool.close()

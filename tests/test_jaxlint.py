@@ -498,13 +498,15 @@ class TestJX5HostOnlyImports:
         assert out == []
 
     def test_telemetry_plane_modules_are_covered(self):
-        """Satellite pin: the host-only prefix covers the telemetry
-        plane — a module-level jax import in exporter.py /
-        flight_recorder.py / compile_watch.py is a JX5 finding (their
-        jax use must stay function-local), and the shipped files are
-        clean."""
+        """Satellite pin (extended by ISSUE 19 with request_trace.py):
+        the host-only prefix covers the telemetry plane — a
+        module-level jax import in exporter.py / flight_recorder.py /
+        compile_watch.py / request_trace.py is a JX5 finding (their
+        jax use must stay function-local; timeline recording runs at
+        decode-burst frequency and must never touch a device), and the
+        shipped files are clean."""
         for mod in ("exporter.py", "flight_recorder.py",
-                    "compile_watch.py"):
+                    "compile_watch.py", "request_trace.py"):
             rel = f"bigdl_tpu/observability/{mod}"
             out = lint(self.SRC, rel=rel)
             assert rules(out) == ["JX5"], rel

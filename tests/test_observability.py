@@ -128,6 +128,24 @@ class TestRegistry:
         assert 'lat_bucket{le="+Inf"} 1' in text
         assert "lat_count 1" in text
 
+    def test_histogram_exemplars(self):
+        """ISSUE 19 pin: an ``observe(v, exemplar=rid)`` remembers the
+        bucket's last trace id; exposition carries it OpenMetrics-style
+        and ``dump()`` keys it by bucket bound, while ``snapshot()``
+        stays exemplar-free (merges unchanged)."""
+        reg = MetricRegistry()
+        h = reg.histogram("lat", buckets=(0.01, 1.0))
+        h.observe(0.005, exemplar="r1")
+        h.observe(0.007, exemplar="r2")        # same bucket: last wins
+        h.observe(0.5)                         # exemplar-free stays so
+        text = reg.expose()
+        assert '# {trace_id="r2"} 0.007' in text
+        assert 'le="1"' in text and 'trace_id="r1"' not in text
+        sample = reg.dump()["lat"]["samples"][0]
+        assert sample["exemplars"]["0.01"]["trace_id"] == "r2"
+        assert "1" not in sample["exemplars"]  # no exemplar, no entry
+        assert "exemplars" not in h.snapshot()
+
     def test_json_dump_roundtrips(self, tmp_path):
         reg = MetricRegistry()
         reg.counter("a_total").inc()

@@ -197,6 +197,33 @@ class TestTS1RepoContract:
                      if names.index(a) < names.index(b)]
             assert ("state_lock", "replica.lock") in pairs, rel
 
+    def test_tracker_lock_declared_as_leaf(self):
+        """ISSUE 19: the request-tracker lock is a declared LEAF of
+        the serving-plane chain — request_trace.py and router.py both
+        order it INSIDE state_lock (so neither may be held while
+        acquiring the other way), and the per-timeline lock nests
+        inside the tracker's."""
+        want = {
+            "bigdl_tpu/observability/request_trace.py": [
+                ("requesttracker.mu", "state_lock"),
+                ("requesttracker.mu", "replica.lock"),
+                ("requesttimeline.mu", "requesttracker.mu")],
+            "bigdl_tpu/serving/router.py": [
+                ("requesttracker.mu", "state_lock")],
+        }
+        for rel, wanted in want.items():
+            info = raceguard._FileInfo(_read(rel), rel)
+            pairs = [(a, b) for names, _ in info.orders
+                     for a in names for b in names
+                     if names.index(a) < names.index(b)]
+            for pw in wanted:
+                assert pw in pairs, (rel, pw)
+        # and request_trace.py IS inside the TS scan scope, so the
+        # repo self-check below actually enforces it
+        assert any("bigdl_tpu/observability/" == p or
+                   "bigdl_tpu/observability/".startswith(p)
+                   for p in raceguard.SCAN_PREFIXES)
+
     def test_real_replica_lock_enforces_declared_order(self):
         # a hypothetical router-side method that calls the REAL
         # Replica.submit while holding a state lock must trip the

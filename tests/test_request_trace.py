@@ -1,0 +1,380 @@
+"""Per-request timelines (bigdl_tpu/observability/request_trace.py;
+ISSUE 19).
+
+The load-bearing invariants, all host-only (no jax import — recording
+is a lock + list append):
+
+- a timeline is BOUNDED: overflow drops events, never seconds — the
+  attribution components stay exact, and terminal events (finish /
+  retire / complete) are appended past the bound so a bounded timeline
+  can't look in-flight;
+- component absorption: place(cause=submit) books queue_s, re-places
+  book migration_s, prefill_end/adopt book prefill_s + queue_s, decode
+  books decode_s + stall_s, export books migration_s;
+- TAIL SAMPLING is provable: every SLO violator (TTFT breach, stall,
+  or abnormal status) is retained in full, the slowest-K of the window
+  are retained, and the fast majority is a deterministic 1-in-N
+  sample — the rest are dropped after aggregation;
+- begin() is idempotent (a requeued request keeps ONE timeline) and
+  finish() is exactly-once;
+- the surfaces: /requests + /requests/<id> + /trace?last= on the
+  exporter, requests.jsonl in a flight-recorder postmortem.
+
+The fleet-integration side (router/batcher emitting real events,
+exactly-once under drain/migrate/publish churn) lives in
+tests/test_serving_router.py and tests/test_deploy.py.
+"""
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from bigdl_tpu.observability import request_trace as rt
+from bigdl_tpu.observability.exporter import (DEFAULT_TRACE_LAST,
+                                              HealthRegistry,
+                                              MetricsServer)
+from bigdl_tpu.observability.flight_recorder import FlightRecorder
+from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.observability.request_trace import (RequestTimeline,
+                                                   RequestTracker,
+                                                   default_tracker)
+from bigdl_tpu.observability.tracing import Tracer
+
+
+def _slo(ttft=0.1, decode=0.01):
+    """The two attributes the tracker reads off an SLOConfig, without
+    importing the serving plane into a host-only unit test."""
+    return SimpleNamespace(ttft_p99_s=ttft, decode_token_p99_s=decode)
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """A controllable monotonic clock: durations become deterministic
+    (the retention policy keys on them)."""
+    state = {"now": 1000.0}
+
+    def advance(dt):
+        state["now"] += dt
+
+    monkeypatch.setattr(rt.time, "monotonic", lambda: state["now"])
+    return advance
+
+
+def _run_request(tracker, rid, *, dur=0.01, ttft=0.001, stall=0.0,
+                 status="ok", clock=None):
+    """Drive one synthetic request through begin/first_token/finish
+    with exact timings (requires the fake ``clock``)."""
+    tracker.begin(rid, prompt_len=4)
+    clock(ttft)
+    tracker.event(rid, "first_token", via="prefill")
+    if stall:
+        tracker.event(rid, "decode", dur_s=stall, stall_s=stall)
+    clock(dur - ttft)
+    return tracker.finish(rid, status=status)
+
+
+# ---------------------------------------------------------------------------
+# RequestTimeline
+
+class TestTimeline:
+    def test_bound_drops_events_never_seconds(self):
+        tl = RequestTimeline("r", max_events=4)
+        for _ in range(10):
+            tl.record("decode", dur_s=0.5, stall_s=0.25)
+        s = tl.summary()
+        assert s["events"] == 4
+        assert s["dropped_events"] == 6
+        # attribution stayed exact through the overflow
+        assert s["components"]["decode_s"] == pytest.approx(5.0)
+        assert s["components"]["stall_s"] == pytest.approx(2.5)
+
+    def test_terminal_events_append_past_the_bound(self):
+        tl = RequestTimeline("r", max_events=2)
+        for _ in range(5):
+            tl.record("decode", dur_s=0.1)
+        tl.record("retire", tokens=7)
+        tl.record("finish", status="ok")
+        names = [e["event"] for e in tl.to_dict()["timeline"]]
+        assert names[-2:] == ["retire", "finish"]
+        assert tl.finished
+        assert tl.summary()["tokens"] == 7
+
+    def test_component_absorption(self):
+        tl = RequestTimeline("r")
+        tl.record("place", cause="submit", wait_s=0.25, replica="r0")
+        tl.record("prefill_end", kind="full", dur_s=0.5, queue_s=0.05,
+                  replica="r0", weight_version="v1")
+        tl.record("decode", dur_s=0.2, stall_s=0.0, replica="r0")
+        tl.record("export", dur_s=0.03, replica="r0")
+        tl.record("place", cause="migrate", wait_s=0.07, replica="r1")
+        tl.record("adopt", queue_s=0.01, replica="r1",
+                  weight_version="v2")
+        c = tl.summary()["components"]
+        assert c["queue_s"] == pytest.approx(0.25 + 0.05 + 0.01)
+        assert c["prefill_s"] == pytest.approx(0.5)
+        assert c["decode_s"] == pytest.approx(0.2)
+        assert c["migration_s"] == pytest.approx(0.03 + 0.07)
+        # identity accumulates ordered-unique across the hop
+        assert tl.summary()["replicas"] == ["r0", "r1"]
+        assert tl.summary()["weight_versions"] == ["v1", "v2"]
+
+    def test_ttft_and_stalled(self, clock):
+        tl = RequestTimeline("r")
+        clock(0.4)
+        tl.record("first_token", via="prefill")
+        clock(0.1)
+        tl.record("first_token", via="adopt")   # first one wins
+        assert tl.ttft_s == pytest.approx(0.4)
+        assert not tl.stalled
+        tl.record("decode", dur_s=1.0, stall_s=0.9)
+        assert tl.stalled
+
+    def test_events_share_one_causal_clock(self):
+        tl = RequestTimeline("r")
+        for ev in ("submit", "route", "place", "prefill_end",
+                   "decode", "finish"):
+            tl.record(ev)
+        ts = [e["t"] for e in tl.to_dict()["timeline"]]
+        assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# RequestTracker: lifecycle + tail sampling
+
+class TestTrackerLifecycle:
+    def test_begin_is_idempotent(self):
+        tr = RequestTracker()
+        a = tr.begin("r", prompt_len=3)
+        b = tr.begin("r", prompt_len=3)       # requeue path re-begins
+        assert a is b
+        assert tr.stats()["started"] == 1
+        names = [e["event"] for e in tr.timeline("r")["timeline"]]
+        assert names.count("submit") == 1
+
+    def test_event_on_unknown_id_is_dropped(self):
+        tr = RequestTracker()
+        assert tr.event("ghost", "decode", dur_s=1.0) is False
+        tr.begin("r")
+        assert tr.event("r", "decode", dur_s=1.0) is True
+
+    def test_finish_exactly_once(self):
+        tr = RequestTracker(sample_every=1)
+        tr.begin("r")
+        first = tr.finish("r")
+        assert first is not None and first["status"] == "ok"
+        assert tr.finish("r") is None          # later calls: no-ops
+        st = tr.stats()
+        assert (st["started"], st["finished"], st["in_flight"]) == \
+            (1, 1, 0)
+
+    def test_thresholds_without_slo_are_inf(self):
+        tr = RequestTracker()
+        assert tr.ttft_slo_s == float("inf")
+        assert tr.stall_threshold_s == float("inf")
+        tr.slo = _slo(ttft=0.5, decode=0.01)
+        assert tr.ttft_slo_s == 0.5
+        # a stall is a pathological burst: stall_factor x the target
+        assert tr.stall_threshold_s == pytest.approx(0.04)
+
+    def test_sample_every_validated(self):
+        with pytest.raises(ValueError, match="sample_every"):
+            RequestTracker(sample_every=0)
+
+    def test_default_tracker_is_process_wide(self):
+        assert default_tracker() is default_tracker()
+
+
+class TestTailSampling:
+    def test_slo_violators_always_retained_fast_ones_sampled(
+            self, clock):
+        """The acceptance proof: every TTFT-breaching request is
+        retained in full while the fast majority is a deterministic
+        1-in-N sample (the rest provably dropped)."""
+        tr = RequestTracker(slo=_slo(ttft=0.1), sample_every=4,
+                            slowest_k=1, window=64)
+        # one slow-but-compliant warmup pins the window max, so every
+        # fast request below takes the sampling path, not "slowest"
+        _run_request(tr, "warm", dur=1.0, ttft=0.05, clock=clock)
+        violators = []
+        fast = []
+        for i in range(24):
+            if i % 6 == 0:
+                rid = f"slow{i}"
+                _run_request(tr, rid, dur=0.6, ttft=0.5, clock=clock)
+                violators.append(rid)
+            else:
+                rid = f"fast{i}"
+                _run_request(tr, rid, dur=0.01, ttft=0.001,
+                             clock=clock)
+                fast.append(rid)
+        kept = {str(tl.request_id): tl.retained_reason
+                for tl in tr.retained()}
+        for rid in violators:                  # ALL violators kept
+            assert kept.get(rid) == "slo", rid
+        sampled = [r for r in fast if r in kept]
+        dropped = [r for r in fast if r not in kept]
+        assert len(sampled) == len(fast) // 4  # deterministic 1-in-4
+        assert all(kept[r] == "sampled" for r in sampled)
+        assert dropped, "sampling must actually drop the majority"
+        st = tr.stats()
+        assert st["retained_by"]["slo"] == len(violators)
+        assert st["sampled_out"] == len(dropped)
+
+    def test_stall_and_abnormal_status_count_as_slo(self, clock):
+        tr = RequestTracker(slo=_slo(), sample_every=1000,
+                            slowest_k=1)
+        _run_request(tr, "warm", dur=1.0, ttft=0.01, clock=clock)
+        _run_request(tr, "stalled", dur=0.02, ttft=0.01, stall=0.5,
+                     clock=clock)
+        _run_request(tr, "shed", dur=0.01, ttft=0.001, status="shed",
+                     clock=clock)
+        kept = {str(tl.request_id): tl.retained_reason
+                for tl in tr.retained()}
+        assert kept.get("stalled") == "slo"
+        assert kept.get("shed") == "slo"
+
+    def test_slowest_k_of_window_retained(self, clock):
+        tr = RequestTracker(slo=None, sample_every=1000, slowest_k=2,
+                            window=16)
+        for i in range(8):                     # establish a window
+            _run_request(tr, f"w{i}", dur=0.1 + i * 0.01,
+                         ttft=0.001, clock=clock)
+        _run_request(tr, "tail", dur=5.0, ttft=0.001, clock=clock)
+        kept = {str(tl.request_id): tl.retained_reason
+                for tl in tr.retained()}
+        assert kept.get("tail") == "slowest"
+
+    def test_retained_ring_is_bounded(self, clock):
+        tr = RequestTracker(slo=_slo(ttft=0.0001), max_retained=4)
+        for i in range(10):                    # all violate -> all kept
+            _run_request(tr, i, dur=0.01, ttft=0.001, clock=clock)
+        kept = [str(tl.request_id) for tl in tr.retained()]
+        assert kept == ["6", "7", "8", "9"]    # oldest fell off first
+
+    def test_timeline_lookup_live_then_retained_newest_wins(
+            self, clock):
+        tr = RequestTracker(sample_every=1)
+        tr.begin(7)
+        # HTTP path hands ids over as strings
+        assert tr.timeline("7")["request_id"] == "7"
+        tr.finish(7)
+        clock(1.0)
+        tr.begin(7)                            # id reuse
+        tr.finish(7)
+        tls = tr.timeline("7")
+        assert tls is not None
+        assert tr.timeline("nope") is None
+        # newest retained entry wins the string lookup
+        assert len(tr.retained()) == 2
+
+
+class TestAttribution:
+    def test_tail_decomposition(self, clock):
+        tr = RequestTracker(slo=_slo(ttft=0.1), sample_every=1)
+        # fast request: negligible everything
+        _run_request(tr, "fast", dur=0.01, ttft=0.001, clock=clock)
+        # the tail request: 0.9s queue wait out of ~1.0s
+        tr.begin("slow")
+        clock(0.9)
+        tr.event("slow", "place", cause="submit", wait_s=0.9,
+                 replica="r0")
+        clock(0.05)
+        tr.event("slow", "prefill_end", dur_s=0.05, queue_s=0.0,
+                 replica="r0")
+        tr.event("slow", "first_token", via="prefill")
+        clock(0.05)
+        tr.event("slow", "decode", dur_s=0.05)
+        tr.finish("slow")
+        attr = tr.attribution()
+        assert attr["requests"] == 2
+        assert attr["tail_requests"] == 1      # only the p99 request
+        assert attr["components"]["queue_s"] == pytest.approx(0.9)
+        assert attr["fractions"]["queue_s"] >= 0.8
+        assert set(attr["components"]) == set(rt.COMPONENTS)
+
+    def test_empty_tracker_attribution(self):
+        attr = RequestTracker().attribution()
+        assert attr["requests"] == 0
+        assert attr["p99_duration_s"] is None
+        assert attr["fractions"] == {}
+
+
+# ---------------------------------------------------------------------------
+# surfaces: exporter endpoints + flight-recorder postmortem
+
+def _server(tracker, tracer=None):
+    return MetricsServer(registry=MetricRegistry(),
+                         tracer=tracer or Tracer(),
+                         health=HealthRegistry(), tracker=tracker)
+
+
+class TestExporterSurfaces:
+    def _tracker(self, clock):
+        tr = RequestTracker(slo=_slo(ttft=0.1), sample_every=1)
+        _run_request(tr, "a", dur=0.5, ttft=0.2, clock=clock)  # slo
+        _run_request(tr, "b", dur=0.01, ttft=0.001, clock=clock)
+        tr.begin("live")                       # still in flight
+        return tr
+
+    def test_requests_index(self, clock):
+        srv = _server(self._tracker(clock))
+        status, ctype, body = srv.render("/requests")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert [s["request_id"] for s in doc["slowest"]] == ["a", "b"]
+        assert [s["request_id"] for s in doc["in_flight"]] == ["live"]
+        assert doc["stats"]["in_flight"] == 1
+        # ?k= caps the slowest list
+        doc = json.loads(srv.render("/requests?k=1")[2])
+        assert [s["request_id"] for s in doc["slowest"]] == ["a"]
+
+    def test_request_detail_and_404(self, clock):
+        srv = _server(self._tracker(clock))
+        status, _, body = srv.render("/requests/a")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["request_id"] == "a"
+        assert [e["event"] for e in doc["timeline"]][0] == "submit"
+        status, _, body = srv.render("/requests/nope")
+        assert status == 404
+        assert json.loads(body)["error"] == "unknown request id"
+
+    def test_index_advertises_request_endpoints(self, clock):
+        _, _, body = _server(RequestTracker()).render("/")
+        assert b"/requests" in body and b"/requests/<id>" in body
+
+    def test_trace_last_cap(self):
+        tracer = Tracer().enable()
+        for i in range(50):
+            tracer.instant(f"e{i}")
+        srv = _server(RequestTracker(), tracer=tracer)
+        doc = json.loads(srv.render("/trace?last=5")[2])
+        assert len(doc["traceEvents"]) == 5
+        assert doc["otherData"]["elided_events"] == 45
+        # default cap is sane (a live scrape must not ship millions)
+        assert DEFAULT_TRACE_LAST == 10_000
+        doc = json.loads(srv.render("/trace")[2])
+        assert len(doc["traceEvents"]) == 50   # under the default cap
+        # ?last=0 lifts the cap: the explicit postmortem-style dump
+        doc = json.loads(srv.render("/trace?last=0")[2])
+        assert len(doc["traceEvents"]) == 50
+        assert "elided_events" not in doc["otherData"]
+
+
+class TestFlightRecorderRequests:
+    def test_postmortem_writes_requests_jsonl(self, tmp_path, clock):
+        tr = RequestTracker(slo=_slo(ttft=0.1), sample_every=1)
+        _run_request(tr, "done", dur=0.5, ttft=0.2, clock=clock)
+        tr.begin("victim")                     # in flight at the crash
+        fr = FlightRecorder(dir=str(tmp_path), registry=MetricRegistry(),
+                            tracer=Tracer(), tracker=tr)
+        out = fr.dump_postmortem(RuntimeError("boom"))
+        path = os.path.join(out, "requests.jsonl")
+        with open(path, encoding="utf-8") as f:
+            recs = [json.loads(line) for line in f]
+        # the crash's victims come first, then the retained tail
+        assert [r["request_id"] for r in recs] == ["victim", "done"]
+        assert recs[0]["status"] == "in_flight"
+        assert recs[1]["retained_reason"] == "slo"
+        assert [e["event"] for e in recs[1]["timeline"]][0] == "submit"

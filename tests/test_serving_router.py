@@ -27,6 +27,7 @@ from bigdl_tpu.models.transformer.serving import ContinuousBatcher
 from bigdl_tpu.observability.exporter import (HealthRegistry,
                                               MetricsServer)
 from bigdl_tpu.observability.registry import MetricRegistry
+from bigdl_tpu.observability.request_trace import RequestTracker
 from bigdl_tpu.serving import (PrefixCache, ReplicaPool, Router,
                                RouterSaturated, SLOConfig)
 
@@ -554,3 +555,135 @@ class TestValidation:
                    for c in health.checks("readiness"))
         pool.close()
         assert health.checks("readiness") == []
+
+
+class TestRequestTimelines:
+    """ISSUE 19: every request through the router leaves ONE causal
+    timeline spanning admission -> placement -> prefill -> decode ->
+    completion, the router_queue_wait_seconds histogram sees EVERY
+    request, and churn (drain migrate=True) never forks or drops a
+    timeline."""
+
+    def test_end_to_end_timeline_and_queue_wait(self, model):
+        tracker = RequestTracker(sample_every=1)
+        health, reg, pool, router = _plane(model, tracker=tracker)
+        try:
+            prompts = _prompts([5, 7, 4, 6], seed=23)
+            for i, p in enumerate(prompts):
+                router.submit(i, p)
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == list(range(4))
+            st = tracker.stats()
+            assert (st["started"], st["finished"], st["in_flight"]) \
+                == (4, 4, 0)
+            # the aggregate queue-wait clock saw EVERY request,
+            # independent of sampling, and rides latency_summary()
+            qw = reg.get("router_queue_wait_seconds").snapshot()
+            assert qw["count"] == 4
+            summ = router.latency_summary()
+            assert summ["queue_wait_count"] == 4
+            assert summ["queue_wait_p99_s"] >= summ["queue_wait_p50_s"]
+            assert summ["attribution"]["requests"] == 4
+            # one causal timeline per request: milestones in order
+            for i in range(4):
+                tl = tracker.timeline(i)
+                names = [e["event"] for e in tl["timeline"]]
+                assert names[0] == "submit" and names[-1] == "finish"
+                for a, b in (("submit", "place"),
+                             ("place", "first_token"),
+                             ("first_token", "complete")):
+                    assert names.index(a) < names.index(b), (i, names)
+                assert names.count("finish") == 1
+                assert tl["status"] == "ok"
+                assert tl["tokens"] == len(res[i])
+                assert tl["replicas"], "no replica attributed"
+                ts = [e["t"] for e in tl["timeline"]]
+                assert ts == sorted(ts)
+        finally:
+            router.close()
+            pool.close()
+
+    def test_tracker_false_disables_timelines_keeps_queue_wait(
+            self, model):
+        health, reg, pool, router = _plane(model, tracker=False)
+        try:
+            router.submit("r", _prompts([5], seed=24)[0])
+            router.wait_all(timeout=60)
+            router.finished()
+            assert reg.get("router_queue_wait_seconds") \
+                .snapshot()["count"] == 1
+            assert router.latency_summary()["attribution"] is None
+        finally:
+            router.close()
+            pool.close()
+
+    def test_queue_wait_exemplar_links_to_timeline(self, model):
+        """The histogram's OpenMetrics exemplar is a live trace id:
+        the scrape can jump from the bucket to /requests/<id>."""
+        tracker = RequestTracker(sample_every=1)
+        health, reg, pool, router = _plane(model, tracker=tracker)
+        try:
+            router.submit("ex1", _prompts([5], seed=25)[0])
+            router.wait_all(timeout=60)
+            router.finished()
+            text = reg.expose()
+            assert '# {trace_id="ex1"}' in text
+            assert tracker.timeline("ex1") is not None
+        finally:
+            router.close()
+            pool.close()
+
+    def test_router_teaches_tracker_the_slo(self, model):
+        tracker = RequestTracker()          # no SLO of its own
+        slo = SLOConfig(long_prefill_tokens=32, ttft_p99_s=1.25)
+        health, reg, pool, router = _plane(model, slo=slo,
+                                           tracker=tracker)
+        try:
+            assert tracker.slo is slo
+            assert tracker.ttft_slo_s == 1.25
+        finally:
+            router.close()
+            pool.close()
+
+    def test_drain_migrate_keeps_one_timeline(self, model):
+        """Exactly-once under churn: a request migrated mid-decode has
+        ONE timeline spanning both replicas — the migration hop is
+        recorded (and booked as migration_s), never a second submit or
+        a forked finish."""
+        tracker = RequestTracker(sample_every=1)
+        geo = dict(max_batch=2, num_pages=64, page_size=4,
+                   max_new_tokens=12, max_burst=2)
+        health, reg, pool, router = _plane(model, geo=geo,
+                                           tracker=tracker)
+        try:
+            p = _prompts([10], seed=17)[0]
+            router.drain("r1", timeout=60)   # force placement on r0
+            r0 = pool["r0"]
+            with r0.lock:                    # freeze r0's driver
+                assert router.submit("mg", p) == "r0"
+                r0.batcher.step(burst=2)     # admit + decode 1 burst
+                router.resume("r1")
+                summary = router.drain("r0", migrate=True, timeout=60)
+            assert summary["migrated"] == 1
+            router.wait_all(timeout=120)
+            res = dict(router.finished())
+            assert sorted(res) == ["mg"]     # exactly once
+            st = tracker.stats()
+            assert (st["started"], st["finished"]) == (1, 1)
+            tl = tracker.timeline("mg")
+            names = [e["event"] for e in tl["timeline"]]
+            assert names.count("submit") == 1
+            assert names.count("finish") == 1
+            assert "migrate" in names and "adopt" in names
+            # the re-placement books migration, not queue wait
+            hops = [e for e in tl["timeline"] if e["event"] == "place"]
+            assert [h["cause"] for h in hops] == ["submit", "migrate"]
+            assert tl["replicas"] == ["r0", "r1"]
+            assert tl["components"]["migration_s"] > 0.0
+            # the queue-wait histogram counted both placements
+            assert reg.get("router_queue_wait_seconds") \
+                .snapshot()["count"] == 2
+        finally:
+            router.close()
+            pool.close()
