@@ -1336,6 +1336,195 @@ def bench_input_pipeline_overlap(iters: int = 12, batch: int = 64):
     }
 
 
+def bench_input_pipeline_nhost(host_counts=(1, 2, 4), iters: int = 6,
+                               batch: int = 32, chunk_records: int = 64):
+    """The input_pipeline_overlap receipt at mesh scale (ISSUE 20): the
+    same overlapped training recipe run as 1/2/4 parallel CPU "host"
+    processes, each a shard of a ``DistributedShuffleDataSet`` over one
+    shared chunked record store. ``value`` is the mean input-wait
+    fraction at the LARGEST host count (lower is better); shard-local IO
+    means it should stay flat as hosts scale — every host reads only its
+    own chunks, so per-host input bandwidth does not shrink with N.
+
+    Two hard receipts ride along and fail the row on violation:
+    the reader open-accounting proves each host touched ONLY its pass-0
+    assignment (pairwise-disjoint across hosts), and an in-process 4->2
+    resize sub-drill proves the chunk-granular mid-epoch resume
+    reconstructs the remaining stream bit-identically."""
+    import subprocess
+    import tempfile
+
+    from bigdl_tpu.dataset.distributed import (chunk_assignment,
+                                               chunk_record_order,
+                                               redistribute_chunk_positions,
+                                               DistributedShuffleDataSet)
+    from bigdl_tpu.dataset.recordstore import (ChunkedRecordReader,
+                                               write_sample_store)
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    # the probes seed 0; the parent-side assignment oracle and the
+    # resize sub-drill must rotate from the same key
+    RandomGenerator.set_seed(0)
+    max_hosts = max(int(n) for n in host_counts)
+    # size the store so each host's pulls (iters consumed + the depth-2
+    # worker's bounded read-ahead) stay strictly inside pass 0 — the
+    # shard-local receipt below pins opens against the PASS-0 assignment
+    n_records = max_hosts * batch * (iters + 8)
+    rs = np.random.RandomState(0)
+    x = rs.rand(n_records, 64).astype(np.float32)
+    y = rs.randint(1, 5, size=(n_records,)).astype(np.int64)
+    tmp = tempfile.mkdtemp(prefix="bench_dataplane_")
+    store = os.path.join(tmp, "train.bcs")
+    write_sample_store(store, (Sample(x[i], y[i])
+                               for i in range(n_records)),
+                       chunk_records=chunk_records)
+    n_chunks = ChunkedRecordReader(store).n_chunks
+
+    wait_fracs = {}
+    for n in sorted(int(c) for c in host_counts):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=_xla_flags_with_device_count(1))
+        procs = []
+        for shard in range(n):
+            cfg = json.dumps({"path": store, "num_shards": n,
+                              "shard_index": shard, "batch": batch,
+                              "iters": iters})
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--dataplane-probe", cfg],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        payloads = []
+        for shard, p in enumerate(procs):
+            out, err = p.communicate(timeout=600)
+            payload = None
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    payload = json.loads(line)
+            if payload is None:
+                tail = (err or "").strip().splitlines()[-2:]
+                raise RuntimeError(
+                    f"dataplane probe (n={n}, shard={shard}) "
+                    f"rc={p.returncode}: "
+                    + (" | ".join(tail) or "no output"))
+            payloads.append(payload)
+        # shard-local IO receipt: every host opened ONLY chunks from its
+        # own pass-0 assignment — disjoint across hosts by construction
+        assign = chunk_assignment(n_chunks, n, 0, seed=0)
+        opened_all: set = set()
+        for payload in payloads:
+            opened = set(payload["chunks_opened"])
+            shard = int(payload["shard"])
+            if not opened <= set(assign[shard]):
+                raise RuntimeError(
+                    f"host {shard}/{n} opened chunks outside its "
+                    f"assignment: {sorted(opened - set(assign[shard]))}")
+            if opened & opened_all:
+                raise RuntimeError(
+                    f"chunks opened by more than one host at n={n}: "
+                    f"{sorted(opened & opened_all)}")
+            opened_all |= opened
+        wait_fracs[n] = sum(p["wait_frac"] for p in payloads) / n
+
+    # resize receipt (no subprocess needed — pure host machinery):
+    # 4 hosts consume one chunk each mid-pass, positions redistribute to
+    # 2 hosts, and the remaining stream must reconstruct bit-identically
+    old_n, new_n = 4, 2
+    dss = [DistributedShuffleDataSet(store, num_shards=old_n,
+                                     shard_index=i, window_chunks=1)
+           for i in range(old_n)]
+    consumed = {}
+    for i, ds in enumerate(dss):
+        it = ds.data(train=True)
+        cid = chunk_assignment(n_chunks, old_n, 0, seed=0)[i][0]
+        for _ in range(ds.reader.chunk_record_count(cid)):
+            next(it)
+        consumed[i] = cid
+    states = [ds.get_position_state() for ds in dss]
+    new_states = redistribute_chunk_positions(states, new_n, seed=0)
+    post = {}
+    for st in new_states:
+        ds2 = DistributedShuffleDataSet(store, num_shards=new_n,
+                                        shard_index=int(st["shard_index"]),
+                                        window_chunks=1)
+        ds2.set_position_state(st, mid_pass=True)
+        it = ds2.data(train=True)
+        for cid in st["remaining_chunks"]:
+            post[cid] = [bytes(memoryview(
+                next(it).feature)) for _ in
+                range(ds2.reader.chunk_record_count(cid))]
+    base_reader = ChunkedRecordReader(store)
+    for cid in set(range(n_chunks)) - set(consumed.values()):
+        recs = base_reader.read_chunk(cid)
+        from bigdl_tpu.dataset.recordstore import decode_sample
+        expect = [bytes(memoryview(decode_sample(*recs[j]).feature))
+                  for j in chunk_record_order(len(recs), 0, cid, seed=0)]
+        if post.get(cid) != expect:
+            raise RuntimeError(
+                f"{old_n}->{new_n} resize resume NOT bit-identical at "
+                f"chunk {cid}")
+
+    counts = sorted(wait_fracs)
+    return {
+        "metric": "input_pipeline_nhost_wait_frac",
+        "value": round(wait_fracs[counts[-1]], 4),
+        "unit": f"mean input-wait fraction at {counts[-1]} hosts",
+        "wait_frac_by_hosts": {str(n): round(wait_fracs[n], 4)
+                               for n in counts},
+        "wait_frac_spread": round(wait_fracs[counts[-1]]
+                                  - wait_fracs[counts[0]], 4),
+        "chunks": n_chunks,
+        "shard_local_reads_verified": True,
+        "resize_resume_bit_identical": True,
+        "iters": iters,
+    }
+
+
+def _dataplane_probe_main(config_json: str):
+    """--dataplane-probe subprocess entry: one emulated host of the
+    N-host drill — train over its shard of the shared record store and
+    emit the measured input-wait fraction plus the reader's chunk-open
+    accounting (the shard-local-IO receipt)."""
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import SampleToBatch, Transformer
+    from bigdl_tpu.dataset.distributed import DistributedShuffleDataSet
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    cfg = json.loads(config_json)
+    RandomGenerator.set_seed(0)
+
+    class HostWork(Transformer):
+        """Same decode/augment stand-in as the overlap row."""
+
+        def __call__(self, it):
+            scratch = np.linspace(0.0, 1.0, 1 << 19, dtype=np.float32)
+            for b in it:
+                for _ in range(8):
+                    scratch = np.tanh(scratch)
+                yield b
+
+    ds = DistributedShuffleDataSet(cfg["path"],
+                                   num_shards=int(cfg["num_shards"]),
+                                   shard_index=int(cfg["shard_index"]))
+    pipeline = ds >> SampleToBatch(int(cfg["batch"])) >> HostWork()
+    model = nn.Sequential(nn.Linear(64, 1024), nn.Tanh(),
+                          nn.Linear(1024, 1024), nn.Tanh(),
+                          nn.Linear(1024, 4), nn.LogSoftMax())
+    o = optim.Optimizer(model=model, dataset=pipeline,
+                        criterion=nn.ClassNLLCriterion())
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    o.set_input_pipeline(depth=2)
+    o.set_end_when(optim.max_iteration(int(cfg["iters"])))
+    o.optimize()
+    wait = o.metrics.stats("host input time")["p50"]
+    dev = o.metrics.stats("device step time")["p50"]
+    _emit({"shard": int(cfg["shard_index"]),
+           "wait_frac": wait / max(wait + dev, 1e-9),
+           "chunks_opened": sorted(ds.reader.chunks_opened)})
+
+
 # shared result of the serving-router workload, keyed by its arguments:
 # both serving rows report one run (the row fns are what tests monkeypatch)
 _serving_run_cache = None
@@ -2134,7 +2323,8 @@ _GATE_LOWER_IS_BETTER = {"serving_ttft", "pipeline_bubble_fraction",
                          "autoscale_time_to_capacity",
                          "publish_to_fleet_secs",
                          "prefix_reuse_ttft",
-                         "request_trace_overhead"}
+                         "request_trace_overhead",
+                         "input_pipeline_nhost_wait_frac"}
 
 GATE_EXIT_CODE = 4
 
@@ -2176,6 +2366,7 @@ _ROW_METRICS = {
         "transformer_lm_ragged_decode_tokens_per_sec_per_chip",
     "decode_spec": "transformer_lm_speculative_decode_tokens_per_sec",
     "input_pipeline": "input_pipeline_overlap",
+    "input_pipeline_nhost": "input_pipeline_nhost_wait_frac",
 }
 _METRIC_TO_ROW = {v: k for k, v in _ROW_METRICS.items()}
 
@@ -2294,7 +2485,8 @@ def main(argv=None):
                              "train_peak_hbm_bytes,multichip_scaling,"
                              "pipeline_bubble_fraction,"
                              "elastic_resume_secs,"
-                             "autoscale_time_to_capacity")
+                             "autoscale_time_to_capacity,"
+                             "input_pipeline_nhost")
     parser.add_argument("--gate", default=None, metavar="BASELINE_JSON",
                         help="compare this run's rows against a "
                              "recorded baseline (per-row thresholds); "
@@ -2366,6 +2558,9 @@ def main(argv=None):
                         help=argparse.SUPPRESS)
     parser.add_argument("--scaling-iters", type=int, default=8,
                         help=argparse.SUPPRESS)
+    parser.add_argument("--dataplane-probe", default=None,
+                        metavar="CONFIG_JSON",
+                        help=argparse.SUPPRESS)   # subprocess entry
     args = parser.parse_args(argv)
     if argv is None and args.gate is None and not args.no_gate:
         # ROADMAP item 5: the committed baseline is ENFORCED on plain
@@ -2411,6 +2606,9 @@ def main(argv=None):
         return
     if args.pipeline_bubble_probe:
         _pipeline_bubble_probe_main(args.pipeline_bubble_geometry)
+        return
+    if args.dataplane_probe is not None:
+        _dataplane_probe_main(args.dataplane_probe)
         return
     global _metrics_server
     if args.serve_metrics is not None:
@@ -2474,7 +2672,8 @@ def _run(args):
                 "train_peak_hbm_bytes", "multichip_scaling",
                 "pipeline_bubble_fraction", "elastic_resume_secs",
                 "autoscale_time_to_capacity", "publish_to_fleet_secs",
-                "prefix_reuse_ttft", "request_trace_overhead"]
+                "prefix_reuse_ttft", "request_trace_overhead",
+                "input_pipeline_nhost"]
 
     known = {"headline", "inception_v2", "real", "real_cached",
              "resnet50", "vgg16", "transformer", "decode",
@@ -2485,7 +2684,7 @@ def _run(args):
              "multichip_scaling", "pipeline_bubble_fraction",
              "elastic_resume_secs", "autoscale_time_to_capacity",
              "publish_to_fleet_secs", "prefix_reuse_ttft",
-             "request_trace_overhead"}
+             "request_trace_overhead", "input_pipeline_nhost"}
     unknown = set(rows) - known
     if unknown:
         raise SystemExit(f"unknown bench rows: {sorted(unknown)} "
@@ -2543,6 +2742,7 @@ def _run(args):
         "publish_to_fleet_secs": bench_publish_to_fleet,
         "prefix_reuse_ttft": bench_prefix_reuse_ttft,
         "request_trace_overhead": bench_request_trace_overhead,
+        "input_pipeline_nhost": bench_input_pipeline_nhost,
     }
     rows_out: list[dict] = []
     headline_failed = False

@@ -9,3 +9,9 @@ from bigdl_tpu.dataset.dataset import (AbstractDataSet, LocalArrayDataSet,
                                        iterator_source)
 from bigdl_tpu.dataset.prefetch import (PrefetchIterator, DevicePrefetcher,
                                         PadPartialBatches)
+from bigdl_tpu.dataset.recordstore import (ChunkedRecordWriter,
+                                           ChunkedRecordReader,
+                                           write_sample_store)
+from bigdl_tpu.dataset.distributed import (DistributedShuffleDataSet,
+                                           chunk_assignment,
+                                           redistribute_chunk_positions)

@@ -225,12 +225,17 @@ class ShardedDataSet(PassRotationMixin, AbstractDataSet):
     """
 
     def __init__(self, data: Sequence, num_shards: int = 1,
-                 shard_index: int = 0):
-        self._all = list(data)
+                 shard_index: int = 0, keep_all: bool = False):
+        data = list(data)
         self.num_shards = num_shards
         self.shard_index = shard_index
         self._seed_shard = shard_index
-        self._local = self._all[shard_index::num_shards]
+        self._local = data[shard_index::num_shards]
+        self._global_size = len(data)
+        # Host RAM must scale with the SHARD, not the dataset: drop the
+        # full list once sliced. ``keep_all`` is the documented opt-out
+        # for callers that re-shard the same instance (tests, notebooks).
+        self._all = data if (keep_all or num_shards <= 1) else None
         self._index = np.arange(len(self._local))
 
     def process_shard_count(self):
@@ -257,7 +262,7 @@ class ShardedDataSet(PassRotationMixin, AbstractDataSet):
 
     def size(self):
         """Global size (reference DistributedDataSet.size counts all)."""
-        return len(self._all)
+        return self._global_size
 
     def local_size(self) -> int:
         return len(self._local)
@@ -298,13 +303,13 @@ class _BatchIterable(AbstractDataSet):
 # ---------------------------------------------------------------------------
 
 def array(data: Sequence, num_shards: int | None = None,
-          shard_index: int = 0) -> AbstractDataSet:
+          shard_index: int = 0, keep_all: bool = False) -> AbstractDataSet:
     """Local or sharded dataset from an in-memory array
     (reference DataSet.array, :281-294 — distributed when a SparkContext
     is passed; here when ``num_shards`` is given)."""
     if num_shards is None:
         return LocalArrayDataSet(data)
-    return ShardedDataSet(data, num_shards, shard_index)
+    return ShardedDataSet(data, num_shards, shard_index, keep_all=keep_all)
 
 
 def iterator_source(make_iter, size: int) -> AbstractDataSet:

@@ -1,0 +1,398 @@
+"""Distributed data plane: shard-local chunk reads + windowed global shuffle.
+
+The reference trains over a partitioned big-data ingestion layer
+(arXiv:1804.05839): every worker reads only its own partitions, and the
+global stream reshuffles across epochs without any node ever holding the
+whole dataset. This module is that contract over the chunked record
+store (``dataset/recordstore.py``):
+
+* **Assignment, not exchange.** Cross-host shuffle is a deterministic
+  rotation of chunk *ownership* per pass — a seed-pure function of
+  ``(seed, shard, pass)`` — so hosts never ship records to each other;
+  they just open a different subset of chunks next epoch. No pass is
+  ever materialized globally.
+* **Windowed per-host shuffle.** Within a pass a host interleaves
+  records from a small window of its assigned chunks, each chunk
+  internally permuted. Record order WITHIN a chunk is deliberately
+  shard-independent (pure in ``(seed, pass, chunk)``), which is what
+  makes mid-epoch resume reconstructible across a host-count resize.
+* **Chunk-granular elastic resume.** Positions checkpoint as
+  (pass, chunks-consumed); :func:`redistribute_chunk_positions` deals
+  the not-yet-consumed chunks of the interrupted pass across a NEW host
+  count the same way elastic checkpoints redistribute optimizer shards
+  (docs/ELASTICITY.md) — partially-consumed chunks replay in full
+  (chunk granularity), fully-consumed chunks never repeat.
+
+Decode/augment stages attach as ordinary transforms and therefore run on
+the ``PrefetchIterator`` worker that pulls this dataset — per-host
+decode overlap comes for free from the existing pipeline.
+
+HOST-ONLY CONTRACT: no module-level jax import (jaxlint JX5 pins this
+file); pure numpy + stdlib threading.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, PassRotationMixin
+from bigdl_tpu.dataset.recordstore import (ChunkedRecordReader, SAMPLE_CODEC,
+                                           decode_sample)
+from bigdl_tpu.dataset.sample import ByteRecord
+from bigdl_tpu.utils.random import RandomGenerator
+
+__all__ = ["pass_chunk_order", "chunk_assignment", "chunk_record_order",
+           "ChunkExchange", "DistributedShuffleDataSet",
+           "redistribute_chunk_positions"]
+
+# Domain salts so the three streams drawn from one (seed, pass, ...) key
+# never alias: chunk order / record order / window picks.
+_SALT_CHUNK_ORDER = 1
+_SALT_RECORD_ORDER = 2
+_SALT_WINDOW = 3
+
+
+def _mixed_generator(*parts, seed=None) -> np.random.Generator:
+    """Seed-pure generator keyed by ``parts`` — same fold constants as
+    ``PassRotationMixin._pass_offset`` so the whole resume contract hangs
+    off one seeding discipline (``RandomGenerator.set_seed``)."""
+    if seed is None:
+        seed = RandomGenerator._default_seed
+    mix = int(seed) % (2 ** 32)
+    for p in parts:
+        mix = (mix * 2654435761 + int(p) + 0x9E3779B9) % (2 ** 32)
+    return np.random.Generator(np.random.MT19937(mix))
+
+
+def pass_chunk_order(n_chunks: int, pass_k: int, seed=None) -> list[int]:
+    """Global chunk permutation for pass ``pass_k`` — identical on every
+    host (no shard in the key), which is what lets hosts agree on
+    ownership without talking."""
+    g = _mixed_generator(_SALT_CHUNK_ORDER, pass_k, seed=seed)
+    return [int(c) for c in g.permutation(int(n_chunks))]
+
+
+def chunk_assignment(n_chunks: int, num_shards: int, pass_k: int,
+                     seed=None) -> list[list[int]]:
+    """Per-shard chunk ownership for one pass: the global pass order
+    dealt round-robin. Disjoint and exhaustive by construction — every
+    chunk lands on exactly one shard each pass, and the deal rotates
+    with the permutation so ownership reshuffles across passes."""
+    order = pass_chunk_order(n_chunks, pass_k, seed=seed)
+    return [order[s::int(num_shards)] for s in range(int(num_shards))]
+
+
+def chunk_record_order(n_records: int, pass_k: int, chunk_id: int,
+                       seed=None) -> list[int]:
+    """Within-chunk record permutation — pure in (seed, pass, chunk),
+    deliberately NOT in shard: whichever host owns the chunk this pass
+    reads it in the same order, so a host-count resize replays the exact
+    record stream (the bit-identity the resize drill pins)."""
+    g = _mixed_generator(_SALT_RECORD_ORDER, pass_k, chunk_id, seed=seed)
+    return [int(i) for i in g.permutation(int(n_records))]
+
+
+def _window_picks(pass_k: int, shard: int, seed=None):
+    """Endless pick stream for the window interleave (which active chunk
+    yields next). Shard IS in the key — interleave is a per-host
+    presentation choice and never crosses hosts."""
+    g = _mixed_generator(_SALT_WINDOW, pass_k, shard, seed=seed)
+    while True:
+        yield int(g.integers(0, 2 ** 31))
+
+
+class ChunkExchange:
+    """Read-ahead thread staging permuted chunks for one pass.
+
+    Decouples chunk IO + permutation from the consumer so the mmap read
+    overlaps the window interleave (the PrefetchIterator worker is this
+    iterator's consumer; the exchange keeps IT fed at chunk granularity).
+    Bounded to ``depth`` staged chunks with backpressure.
+    """
+    # raceguard: order chunkexchange.mu < pos_lock
+
+    def __init__(self, reader: ChunkedRecordReader, chunks,
+                 record_order_fn, depth: int = 2):
+        self._reader = reader
+        self._chunks = list(chunks)
+        self._order_fn = record_order_fn
+        self._depth = max(1, int(depth))
+        self._mu = threading.Condition()
+        self._staged: list[tuple[int, list]] = []
+        self._done = False
+        self._stop = False
+        self._exc = None
+        self._thread = threading.Thread(target=self._work,
+                                        name="chunk-exchange", daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        try:
+            for cid in self._chunks:
+                with self._mu:
+                    while len(self._staged) >= self._depth and not self._stop:
+                        self._mu.wait()
+                    if self._stop:
+                        return
+                # chunk IO + permutation OUTSIDE the condition: the
+                # consumer keeps draining while the next chunk loads
+                records = self._reader.read_chunk(cid)
+                order = self._order_fn(len(records), cid)
+                permuted = [(records[i], i) for i in order]
+                with self._mu:
+                    if self._stop:
+                        return
+                    self._staged.append((cid, permuted))
+                    self._mu.notify_all()
+        except BaseException as e:  # surfaced to the consumer
+            with self._mu:
+                self._exc = e
+                self._mu.notify_all()
+        finally:
+            with self._mu:
+                self._done = True
+                self._mu.notify_all()
+
+    def next_chunk(self):
+        """Next (chunk_id, [((data, label), stored_index), ...]) or None
+        when the pass's chunk list is exhausted."""
+        with self._mu:
+            while not self._staged and not self._done and self._exc is None:
+                self._mu.wait()
+            if self._exc is not None:
+                raise self._exc
+            if self._staged:
+                item = self._staged.pop(0)
+                self._mu.notify_all()
+                return item
+            return None
+
+    def close(self):
+        with self._mu:
+            self._stop = True
+            self._mu.notify_all()
+        self._thread.join(timeout=10.0)
+
+
+class DistributedShuffleDataSet(PassRotationMixin, AbstractDataSet):
+    """Sharded training stream over a chunked record store.
+
+    Each host opens ONLY the chunks its shard owns this pass (the
+    reader's ``chunks_opened`` accounting is the receipt); ownership
+    rotates per pass via :func:`chunk_assignment`, so the global stream
+    reshuffles across epochs without a global materialization.
+
+    ``window_chunks`` bounds host memory: at most that many chunks are
+    decoded-and-interleaving at once (plus ``exchange_depth`` staged
+    read-ahead chunks), independent of dataset size.
+    """
+
+    def __init__(self, store, *, num_shards: int = 1, shard_index: int = 0,
+                 window_chunks: int = 2, decode=None, exchange_depth: int = 2):
+        self._reader = store if isinstance(store, ChunkedRecordReader) \
+            else ChunkedRecordReader(store)
+        if self._reader.n_chunks < num_shards:
+            raise ValueError(
+                f"store has {self._reader.n_chunks} chunks for "
+                f"{num_shards} shards — at least one chunk per shard is "
+                "required (write with a smaller chunk_records)")
+        self.num_shards = int(num_shards)
+        self.shard_index = int(shard_index)
+        self._seed_shard = self.shard_index
+        self._window = max(1, int(window_chunks))
+        self._exchange_depth = int(exchange_depth)
+        # decode: None = by store codec, False = raw ByteRecords,
+        # callable = custom per-record decode (runs on whatever thread
+        # pulls this iterator — the PrefetchIterator worker in training)
+        if decode is None and self._reader.codec == SAMPLE_CODEC:
+            decode = decode_sample
+        self._decode = decode or None
+        self._pos_lock = threading.Lock()
+        self._pass_count = 0
+        self._chunks_done = 0
+        self._resume_chunks = None
+
+    # -- identity -------------------------------------------------------
+    @property
+    def reader(self) -> ChunkedRecordReader:
+        return self._reader
+
+    def is_sharded(self):
+        return self.num_shards > 1
+
+    def process_shard_count(self):
+        return self.num_shards
+
+    def process_shard_index(self):
+        return self.shard_index
+
+    def size(self):
+        """Global record count (same semantics as ShardedDataSet.size)."""
+        return self._reader.n_records
+
+    def local_size(self) -> int:
+        """Records in this shard's pass-0 assignment (pass-to-pass the
+        count can shift by one short chunk; epoch accounting is global)."""
+        chunks = chunk_assignment(self._reader.n_chunks, self.num_shards,
+                                  0)[self.shard_index]
+        return sum(self._reader.chunk_record_count(c) for c in chunks)
+
+    # -- streams --------------------------------------------------------
+    def _wrap(self, data, label, chunk_id, stored_i):
+        if self._decode is not None:
+            return self._decode(data, label)
+        return ByteRecord(data, label,
+                          key=(self._reader.path, chunk_id, stored_i))
+
+    def _iter_pass(self, k: int, chunks):
+        ex = ChunkExchange(self._reader, chunks,
+                           lambda n, cid: chunk_record_order(n, k, cid),
+                           depth=self._exchange_depth)
+        picks = _window_picks(k, self.shard_index)
+        active: list[list] = []   # [chunk_id, permuted_records, next_idx]
+        try:
+            feed_dry = False
+            while True:
+                while len(active) < self._window and not feed_dry:
+                    item = ex.next_chunk()
+                    if item is None:
+                        feed_dry = True
+                    else:
+                        active.append([item[0], item[1], 0])
+                if not active:
+                    break
+                j = next(picks) % len(active)
+                cid, records, idx = active[j]
+                (data, label), stored_i = records[idx]
+                active[j][2] = idx + 1
+                if idx + 1 >= len(records):
+                    active.pop(j)
+                    with self._pos_lock:
+                        self._chunks_done += 1
+                yield self._wrap(data, label, cid, stored_i)
+        finally:
+            ex.close()
+
+    def data(self, train: bool):
+        if train:
+            if self._reader.n_records == 0:
+                raise ValueError("cannot build a training iterator over an "
+                                 "empty record store")
+
+            def endless():
+                while True:
+                    with self._pos_lock:
+                        k = self._pass_count
+                        self._pass_count = k + 1
+                        self._chunks_done = 0
+                        override = self._resume_chunks
+                        self._resume_chunks = None
+                    if override is not None:
+                        chunks = list(override)
+                    else:
+                        chunks = chunk_assignment(
+                            self._reader.n_chunks, self.num_shards,
+                            k)[self.shard_index]
+                    yield from self._iter_pass(k, chunks)
+            return endless()
+
+        def single():
+            chunks = sorted(chunk_assignment(
+                self._reader.n_chunks, self.num_shards, 0)[self.shard_index])
+            for c in chunks:
+                for i, (data, label) in enumerate(self._reader.read_chunk(c)):
+                    yield self._wrap(data, label, c, i)
+        return single()
+
+    def shuffle(self):
+        """No-op: cross-pass reshuffle IS the per-pass assignment
+        rotation — nothing to draw from the host RNG stream."""
+
+    # -- resume contract ------------------------------------------------
+    def get_position_state(self):
+        with self._pos_lock:
+            return {"passes_started": self._pass_count,
+                    "chunks_done": self._chunks_done,
+                    "num_shards": self.num_shards,
+                    "shard_index": self.shard_index,
+                    "n_chunks": self._reader.n_chunks}
+
+    def set_position_state(self, state, mid_pass: bool = False):
+        passes = int(np.asarray(state.get("passes_started", 0)))
+        rc = state.get("remaining_chunks")
+        with self._pos_lock:
+            # mid_pass: replay pass k = passes-1 (mixin semantics)
+            self._pass_count = passes - 1 if (mid_pass and passes > 0) \
+                else passes
+            self._chunks_done = 0
+            # one-shot ownership override for the replayed pass — set by
+            # redistribute_chunk_positions after a host-count resize
+            self._resume_chunks = list(rc) if rc is not None else None
+
+    def advance_position_state(self, state):
+        out = dict(state)
+        out["passes_started"] = \
+            int(np.asarray(state.get("passes_started", 0))) + 1
+        out["chunks_done"] = 0
+        out.pop("remaining_chunks", None)
+        return out
+
+    def close(self):
+        self._reader.close()
+
+
+def redistribute_chunk_positions(states, new_num_shards: int, *, seed=None):
+    """Deal an interrupted pass's unconsumed chunks across a NEW host
+    count — the data-plane analogue of elastic checkpoint
+    redistribution (docs/ELASTICITY.md).
+
+    ``states``: one ``get_position_state()`` dict per OLD shard (any
+    order). Chunk-granular contract: a chunk counts as consumed only
+    when fully drained — partially-read chunks replay in full on the new
+    fleet, fully-consumed chunks never repeat, and because within-chunk
+    record order is shard-independent the remaining stream reconstructs
+    bit-identically. Returns one state per NEW shard; apply each with
+    ``set_position_state(state, mid_pass=True)``.
+    """
+    if not states:
+        raise ValueError("need at least one old-shard position state")
+    first = states[0]
+    n_chunks = int(first["n_chunks"])
+    old_shards = int(first["num_shards"])
+    passes = int(first["passes_started"])
+    new_num_shards = int(new_num_shards)
+    if new_num_shards < 1 or new_num_shards > n_chunks:
+        raise ValueError(f"new_num_shards={new_num_shards} out of range "
+                         f"for a {n_chunks}-chunk store")
+    if len(states) != old_shards:
+        raise ValueError(f"got {len(states)} states for "
+                         f"{old_shards} old shards")
+    seen = set()
+    for st in states:
+        if (int(st["n_chunks"]), int(st["num_shards"]),
+                int(st["passes_started"])) != (n_chunks, old_shards, passes):
+            raise ValueError("inconsistent position states — not one "
+                             "snapshot of one fleet")
+        seen.add(int(st["shard_index"]))
+    if seen != set(range(old_shards)):
+        raise ValueError(f"shard indices {sorted(seen)} do not cover "
+                         f"0..{old_shards - 1}")
+
+    base = {"chunks_done": 0, "num_shards": new_num_shards,
+            "n_chunks": n_chunks}
+    if passes == 0:   # nothing started — fresh states, no override
+        return [dict(base, passes_started=0, shard_index=s)
+                for s in range(new_num_shards)]
+
+    k = passes - 1    # the interrupted pass
+    assign = chunk_assignment(n_chunks, old_shards, k, seed=seed)
+    consumed = set()
+    for st in states:
+        s = int(st["shard_index"])
+        consumed.update(assign[s][:int(st["chunks_done"])])
+    remaining = [c for c in pass_chunk_order(n_chunks, k, seed=seed)
+                 if c not in consumed]
+    return [dict(base, passes_started=passes, shard_index=s,
+                 remaining_chunks=remaining[s::new_num_shards])
+            for s in range(new_num_shards)]

@@ -113,7 +113,7 @@ class PrefetchIterator:
 
     def __init__(self, source, *, depth: int = 2, stage=None,
                  max_records: int | None = None, records_scale: int = 1,
-                 name: str = "input", dataset=None):
+                 name: str = "input", dataset=None, shard=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         if dataset is not None:
@@ -130,6 +130,10 @@ class PrefetchIterator:
         self._max_records = max_records
         self._scale = max(1, int(records_scale))
         self._name = name
+        # per-host starvation attribution: which process shard this
+        # pipeline feeds ("0" for single-host / unsharded sources)
+        self._labels = {"pipeline": name,
+                        "shard": str(shard if shard is not None else 0)}
         self._dataset = dataset
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -138,11 +142,12 @@ class PrefetchIterator:
         reg = default_registry()
         self._gauge = reg.gauge(
             "prefetch_queue_depth",
-            "batches ready in the prefetch queue", labelnames=("pipeline",))
+            "batches ready in the prefetch queue",
+            labelnames=("pipeline", "shard"))
         self._starved = reg.counter(
             "input_starvation_total",
             "consumer blocked on an empty prefetch queue",
-            labelnames=("pipeline",))
+            labelnames=("pipeline", "shard"))
         # the worker continues the CREATOR's host-RNG stream: transforms
         # drawing augmentation randomness must land exactly where the
         # synchronous loop's draws would (bit-identical contract). The
@@ -201,10 +206,10 @@ class PrefetchIterator:
         if self._done:
             raise StopIteration
         if self._q.empty() and self._worker.is_alive():
-            self._starved.inc(pipeline=self._name)
+            self._starved.inc(**self._labels)
             trace.instant("input starvation", pipeline=self._name)
         item = self._q.get()
-        self._gauge.set(self._q.qsize(), pipeline=self._name)
+        self._gauge.set(self._q.qsize(), **self._labels)
         if item is _DONE:
             self._finish()
             raise StopIteration
@@ -296,16 +301,17 @@ class _SyncPipeline:
 def open_input_pipeline(source, *, depth: int, stage=None,
                         max_records: int | None = None,
                         records_scale: int = 1, name: str = "input",
-                        dataset=None):
+                        dataset=None, shard=None):
     """Factory the optimizers use: ``depth == 0`` is today's synchronous
     path (stages run inline on the consumer thread), ``depth >= 1``
-    overlaps them on a prefetch worker."""
+    overlaps them on a prefetch worker. ``shard`` labels the starvation
+    metrics with the process shard index (per-host attribution)."""
     if depth <= 0:
         return _SyncPipeline(source, stage, name=name)
     return PrefetchIterator(source, depth=depth, stage=stage,
                             max_records=max_records,
                             records_scale=records_scale, name=name,
-                            dataset=dataset)
+                            dataset=dataset, shard=shard)
 
 
 class PadPartialBatches:

@@ -624,6 +624,7 @@ class DistriOptimizer(Optimizer):
                 driver_state["neval"] += 1
                 if count_this_epoch >= epoch_size:
                     self._drain_pending(pending, driver_state, "epoch end")
+                    self._emit_input_wait_fraction(driver_state["neval"])
                     # epoch-end checkpoint barrier: pending async saves
                     # commit before the next epoch dispatches
                     self._ckpt_barrier()

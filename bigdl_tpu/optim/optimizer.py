@@ -140,6 +140,11 @@ class Optimizer:
         self._ckpt_mesh = None
         self.checkpoint_receipt = None
         self.metrics = Metrics()
+        # per-epoch input-wait accounting (host-side span timers only;
+        # step_time already contains data_time, so the fraction is
+        # wait / total — reset at each epoch boundary)
+        self._epoch_wait_s = 0.0
+        self._epoch_total_s = 0.0
         self.profile_dir = None
         self.profile_start = 0
         self.profile_iters = 0
@@ -659,12 +664,29 @@ class Optimizer:
         everything here is host arithmetic."""
         self.metrics.record("device step time", device_time)
         self.metrics.record("host input time", data_time)
+        self._epoch_wait_s += data_time
+        self._epoch_total_s += step_time
         if self.train_summary is not None:
             s = self.train_summary
             s.add_scalar("Loss", loss, neval)
             s.add_scalar("Throughput", n / max(step_time, 1e-9), neval)
             s.add_scalar("HostInputTime", data_time, neval)
             s.add_scalar("DeviceStepTime", device_time, neval)
+
+    def _emit_input_wait_fraction(self, neval: int) -> None:
+        """Epoch-end roll-up of the per-step host-side span timers: what
+        fraction of the epoch's wall time the consumer spent waiting on
+        input. Pure host arithmetic over already-collected floats — no
+        device sync — labeled per host by the shard-tagged starvation
+        metrics it complements (dataset/prefetch.py)."""
+        if self._epoch_total_s <= 0:
+            return
+        frac = min(1.0, self._epoch_wait_s / self._epoch_total_s)
+        self.metrics.set("input wait fraction", frac)
+        if self.train_summary is not None:
+            self.train_summary.add_scalar("InputWaitFraction", frac, neval)
+        self._epoch_wait_s = 0.0
+        self._epoch_total_s = 0.0
 
     def _validate(self, apply_fn, params, mstate, driver_state, *,
                   fire: bool | None = None):
@@ -701,7 +723,8 @@ class Optimizer:
             # pipeline already holds that dataset's worker guard
             dataset=(self.validation_dataset
                      if self.validation_dataset is not self.dataset
-                     else None))
+                     else None),
+            shard=self.validation_dataset.process_shard_index())
         try:
             with trace.span("validation",
                             host_sync="per-batch metric eval"):
@@ -1117,7 +1140,8 @@ class Optimizer:
         return open_input_pipeline(raw, depth=self.prefetch_depth,
                                    stage=stage, max_records=max_records,
                                    records_scale=records_scale,
-                                   name="train", dataset=self.dataset)
+                                   name="train", dataset=self.dataset,
+                                   shard=self.dataset.process_shard_index())
 
 
 class LocalOptimizer(Optimizer):
@@ -1261,6 +1285,7 @@ class LocalOptimizer(Optimizer):
                 driver_state["neval"] += 1
                 if count_this_epoch >= epoch_size:
                     self._drain_pending(pending, driver_state, "epoch end")
+                    self._emit_input_wait_fraction(driver_state["neval"])
                     # epoch-end checkpoint barrier: pending async saves
                     # commit before the next epoch dispatches (bounds
                     # queued snapshots; surfaces background save errors

@@ -38,7 +38,8 @@ __all__ = ["tune", "TuneResult", "Measurement", "VMEM_BUDGET_BYTES",
            "flash_candidates", "flash_est_vmem", "fused_ce_candidates",
            "fused_ce_est_vmem", "lrn_candidates", "lrn_est_vmem",
            "maxpool_candidates", "bucket_mb_candidates",
-           "batch_geometry_candidates", "tile_divisors",
+           "batch_geometry_candidates", "chunk_records_candidates",
+           "tile_divisors",
            "paged_attention_candidates", "paged_attention_est_vmem",
            "step_memory_candidates", "step_memory_est_hbm",
            "pipeline_schedule_candidates", "pipeline_est_hbm"]
@@ -414,4 +415,19 @@ def batch_geometry_candidates(global_batch: int, n_shards: int,
         b = int(global_batch * (2.0 ** k))
         if b >= n_shards and b % n_shards == 0:
             out.append({"batch": b})
+    return out
+
+
+def chunk_records_candidates(n_records: int,
+                             num_shards: int = 1) -> list[dict]:
+    """Record-store chunk sizes (dataset/recordstore.py): small chunks
+    shuffle finer and rebalance better across hosts, big chunks amortize
+    footer/index overhead and read sequentially. Octave scan filtered so
+    every shard owns at least one chunk per pass
+    (dataset/distributed.py's assignment precondition)."""
+    out = []
+    for cr in (64, 128, 256, 512, 1024, 2048):
+        n_chunks = (int(n_records) + cr - 1) // cr
+        if n_chunks >= max(1, int(num_shards)):
+            out.append({"chunk_records": cr})
     return out

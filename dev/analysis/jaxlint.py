@@ -78,6 +78,8 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # them
 HOST_ONLY_PREFIXES = ("bigdl_tpu/observability/",
                       "bigdl_tpu/dataset/prefetch.py",
+                      "bigdl_tpu/dataset/recordstore.py",
+                      "bigdl_tpu/dataset/distributed.py",
                       "bigdl_tpu/serving/",
                       "bigdl_tpu/tuning/",
                       "bigdl_tpu/elastic/",

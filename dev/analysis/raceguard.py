@@ -91,6 +91,8 @@ SCAN_PREFIXES = (
     "bigdl_tpu/elastic/",
     "bigdl_tpu/deploy/",
     "bigdl_tpu/dataset/prefetch.py",
+    "bigdl_tpu/dataset/recordstore.py",
+    "bigdl_tpu/dataset/distributed.py",
     "bigdl_tpu/observability/",
     "scripts/",
 )

@@ -1327,3 +1327,63 @@ class TestRequestTraceRow:
         assert row["value"] > 0
         assert row["drill_queue_fraction"] >= 0.8
         assert row["timelines"] == row["retained"] == 7
+
+
+class TestInputPipelineNHostRow:
+    """ISSUE 20: input_pipeline_nhost — the overlap receipt at mesh
+    scale (1/2/4 emulated hosts over one chunked record store) — rides
+    the standard row/known/all contract. Wait fraction is lower-is-
+    better and the gate knows."""
+
+    FAKE = {"metric": "input_pipeline_nhost_wait_frac", "value": 0.03,
+            "unit": "mean input-wait fraction at 4 hosts",
+            "wait_frac_by_hosts": {"1": 0.02, "2": 0.03, "4": 0.03},
+            "wait_frac_spread": 0.01, "chunks": 24,
+            "shard_local_reads_verified": True,
+            "resize_resume_bit_identical": True, "iters": 6}
+
+    def test_row_wiring_and_registry_export(self, monkeypatch, capsys,
+                                            tmp_path):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: ("cpu|test|1", None))
+        monkeypatch.setattr(bench, "bench_input_pipeline_nhost",
+                            lambda **kw: dict(self.FAKE))
+        out = str(tmp_path / "metrics.txt")
+        bench.main(["--rows", "input_pipeline_nhost",
+                    "--metrics-out", out])
+        lines = _parse_lines(capsys.readouterr().out)
+        assert lines[0]["metric"] == "input_pipeline_nhost_wait_frac"
+        assert lines[-1]["rows"][0]["value"] == 0.03
+        with open(out) as f:
+            assert "bench_input_pipeline_nhost_wait_frac 0.03" in f.read()
+
+    def test_row_in_all_and_gate_direction(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_probe_backend",
+                            lambda timeout_s: (None, "wedged"))
+        with pytest.raises(SystemExit):
+            bench.main(["--rows", "all"])
+        agg = _parse_lines(capsys.readouterr().out)[-1]
+        assert "input_pipeline_nhost" in \
+            [r["metric"] for r in agg["rows"]]
+        # a host waiting LONGER on input as the fleet grows is the
+        # regression
+        assert "input_pipeline_nhost_wait_frac" in \
+            bench._GATE_LOWER_IS_BETTER
+        assert bench._ROW_METRICS["input_pipeline_nhost"] == \
+            "input_pipeline_nhost_wait_frac"
+
+    @pytest.mark.slow
+    def test_real_nhost_drill_tiny_geometry(self):
+        """The REAL drill (tiny geometry, 1/2 hosts): subprocess hosts
+        train over disjoint shard-local chunk sets, and the 4->2
+        resize sub-drill reconstructs the remaining stream
+        bit-identically — both receipts are hard failures inside the
+        row, so a returned row IS the proof."""
+        row = bench.bench_input_pipeline_nhost(
+            host_counts=(1, 2), iters=2, batch=8, chunk_records=8)
+        assert row["metric"] == "input_pipeline_nhost_wait_frac"
+        assert 0.0 <= row["value"] <= 1.0
+        assert set(row["wait_frac_by_hosts"]) == {"1", "2"}
+        assert row["shard_local_reads_verified"] is True
+        assert row["resize_resume_bit_identical"] is True
+        assert row["chunks"] >= 4    # the resize sub-drill needs 4 hosts

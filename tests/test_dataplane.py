@@ -1,0 +1,449 @@
+"""Distributed data plane tests (ISSUE 20).
+
+Chunked record store (dataset/recordstore.py), shard-local reads +
+windowed global shuffle (dataset/distributed.py), the chunk-granular
+resize-resume contract, and the ShardedDataSet footprint fix. Everything
+here except the optimizer smoke is jax-free host machinery — tier-1
+cheap by construction; the subprocess N-host drill lives in
+test_bench_contract.py under ``-m slow``.
+"""
+import gc
+import weakref
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.dataset import ShardedDataSet
+from bigdl_tpu.dataset.distributed import (ChunkExchange,
+                                           DistributedShuffleDataSet,
+                                           chunk_assignment,
+                                           chunk_record_order,
+                                           redistribute_chunk_positions)
+from bigdl_tpu.dataset.recordstore import (ChunkedRecordReader,
+                                           ChunkedRecordWriter,
+                                           decode_sample, encode_sample,
+                                           write_sample_store)
+from bigdl_tpu.dataset.sample import ByteRecord, Sample
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    RandomGenerator.set_seed(0)
+    yield
+
+
+def _store(tmp_path, n_records=37, chunk_records=5, dim=4):
+    path = str(tmp_path / "t.bcs")
+    write_sample_store(
+        path, (Sample(np.arange(dim, dtype=np.float32) + i, float(i % 3))
+               for i in range(n_records)),
+        chunk_records=chunk_records)
+    return path
+
+
+def _first_val(rec):
+    return float(rec.feature[0])
+
+
+# ---------------------------------------------------------------------------
+# chunked record store
+# ---------------------------------------------------------------------------
+
+class TestRecordStore:
+    def test_roundtrip_and_footer_geometry(self, tmp_path):
+        path = str(tmp_path / "s.bcs")
+        with ChunkedRecordWriter(path, chunk_records=4) as w:
+            for i in range(10):
+                w.write(bytes([i] * (i + 1)), label=float(i))
+        r = ChunkedRecordReader(path)
+        assert r.n_records == 10
+        assert r.n_chunks == 3            # 4 + 4 + 2 (short last chunk)
+        assert r.chunk_record_count(0) == 4
+        assert r.chunk_record_count(2) == 2
+        flat = [rec for c in range(r.n_chunks) for rec in r.read_chunk(c)]
+        assert flat == [(bytes([i] * (i + 1)), float(i))
+                        for i in range(10)]
+
+    def test_random_access_within_chunk(self, tmp_path):
+        path = _store(tmp_path)
+        r = ChunkedRecordReader(path)
+        data, label = r.read_record(3, 2)    # record 3*5+2 = 17
+        s = decode_sample(data, label)
+        assert s.feature[0] == 17.0 and float(s.label) == float(17 % 3)
+
+    def test_reader_is_lazy_and_accounts_opens(self, tmp_path):
+        path = _store(tmp_path)
+        r = ChunkedRecordReader(path)
+        # construction reads only the footer — no chunk bytes touched
+        assert r.open_count == 0 and r.chunks_opened == []
+        r.read_chunk(5)
+        r.read_chunk(1)
+        r.read_chunk(5)                      # re-read: accounted once
+        assert r.chunks_opened == [5, 1]
+        assert r.open_count == 2
+
+    def test_sample_codec_roundtrip(self):
+        f = np.arange(12, dtype=np.float16).reshape(3, 4)
+        data, label = encode_sample(f, 7)
+        s = decode_sample(data, label)
+        assert s.feature.dtype == np.float16 and s.feature.shape == (3, 4)
+        np.testing.assert_array_equal(s.feature, f)
+        assert float(s.label) == 7.0
+
+    def test_unclosed_writer_is_refused(self, tmp_path):
+        path = str(tmp_path / "torn.bcs")
+        w = ChunkedRecordWriter(path, chunk_records=4)
+        w.write(b"x", 0.0)
+        w._f.flush()                         # crash before close(): data
+        with pytest.raises(ValueError, match="trailer"):
+            ChunkedRecordReader(path)        # on disk but no trailer
+
+    def test_bad_magic_and_bad_chunk_records(self, tmp_path):
+        bad = tmp_path / "bad.bcs"
+        bad.write_bytes(b"NOPE" + b"\x00" * 64)
+        with pytest.raises(ValueError, match="magic"):
+            ChunkedRecordReader(str(bad))
+        with pytest.raises(ValueError, match="chunk_records"):
+            ChunkedRecordWriter(str(tmp_path / "x.bcs"), chunk_records=0)
+
+    def test_closed_reader_refuses_reads(self, tmp_path):
+        r = ChunkedRecordReader(_store(tmp_path))
+        r.read_chunk(0)
+        r.close()
+        with pytest.raises(ValueError, match="closed"):
+            r.read_chunk(1)
+
+
+# ---------------------------------------------------------------------------
+# chunk assignment: pure function of (seed, shard, pass)
+# ---------------------------------------------------------------------------
+
+class TestChunkAssignment:
+    def test_partition_oracle_small_geometries(self):
+        """Brute force: for every small geometry, every pass's
+        assignment is a disjoint, exhaustive, balanced partition — no
+        two hosts ever own the same chunk in a pass."""
+        for n_chunks in range(1, 13):
+            for num_shards in range(1, min(n_chunks, 5) + 1):
+                for k in range(7):
+                    a = chunk_assignment(n_chunks, num_shards, k, seed=0)
+                    assert len(a) == num_shards
+                    flat = [c for sh in a for c in sh]
+                    assert sorted(flat) == list(range(n_chunks)), \
+                        (n_chunks, num_shards, k)
+                    sizes = [len(sh) for sh in a]
+                    assert max(sizes) - min(sizes) <= 1
+
+    def test_pure_in_seed_shard_pass(self):
+        # same key -> same answer, independent of ambient RNG state
+        a1 = chunk_assignment(16, 4, 3, seed=11)
+        RandomGenerator.RNG().shuffle(np.arange(50))      # perturb RNG
+        RandomGenerator.set_seed(999)
+        a2 = chunk_assignment(16, 4, 3, seed=11)
+        assert a1 == a2
+        # different pass / different seed -> different permutation
+        assert a1 != chunk_assignment(16, 4, 4, seed=11)
+        assert a1 != chunk_assignment(16, 4, 3, seed=12)
+
+    def test_default_seed_follows_random_generator(self):
+        RandomGenerator.set_seed(5)
+        a5 = chunk_assignment(12, 3, 0)
+        RandomGenerator.set_seed(6)
+        assert chunk_assignment(12, 3, 0) != a5
+        RandomGenerator.set_seed(5)
+        assert chunk_assignment(12, 3, 0) == a5
+
+    def test_assignment_rotates_across_passes(self):
+        # over a few passes, a given shard must not keep the same chunks
+        owned = {frozenset(chunk_assignment(12, 4, k, seed=0)[0])
+                 for k in range(6)}
+        assert len(owned) > 1
+
+    def test_record_order_is_shard_independent(self):
+        """Within-chunk order keys on (seed, pass, chunk) only — the
+        property the resize bit-identity stands on."""
+        o = chunk_record_order(9, 2, 5, seed=3)
+        assert sorted(o) == list(range(9))
+        assert o == chunk_record_order(9, 2, 5, seed=3)
+        assert o != chunk_record_order(9, 3, 5, seed=3)
+        assert o != chunk_record_order(9, 2, 6, seed=3)
+
+
+# ---------------------------------------------------------------------------
+# DistributedShuffleDataSet
+# ---------------------------------------------------------------------------
+
+class TestDistributedShuffleDataSet:
+    def test_each_pass_is_exactly_once_across_shards(self, tmp_path):
+        path = _store(tmp_path)
+        dss = [DistributedShuffleDataSet(path, num_shards=2, shard_index=i)
+               for i in range(2)]
+        got = []
+        for ds in dss:
+            it = ds.data(train=True)
+            got += [_first_val(next(it)) for _ in range(ds.local_size())]
+        assert sorted(got) == [float(i) for i in range(37)]
+
+    def test_shard_opens_only_its_chunks(self, tmp_path):
+        path = _store(tmp_path)
+        assign = chunk_assignment(8, 2, 0, seed=0)
+        for i in range(2):
+            ds = DistributedShuffleDataSet(path, num_shards=2,
+                                           shard_index=i)
+            it = ds.data(train=True)
+            for _ in range(ds.local_size()):
+                next(it)
+            assert set(ds.reader.chunks_opened) <= set(assign[i])
+
+    def test_stream_reshuffles_across_passes(self, tmp_path):
+        ds = DistributedShuffleDataSet(_store(tmp_path))
+        it = ds.data(train=True)
+        p0 = [_first_val(next(it)) for _ in range(37)]
+        p1 = [_first_val(next(it)) for _ in range(37)]
+        assert sorted(p0) == sorted(p1)
+        assert p0 != p1
+
+    def test_mid_pass_resume_replays_bit_identically(self, tmp_path):
+        path = _store(tmp_path)
+        ds = DistributedShuffleDataSet(path)
+        state = ds.get_position_state()
+        it = ds.data(train=True)
+        first = [_first_val(next(it)) for _ in range(50)]   # into pass 1
+        ds2 = DistributedShuffleDataSet(path)
+        ds2.set_position_state(ds.advance_position_state(state),
+                               mid_pass=True)
+        it2 = ds2.data(train=True)
+        # advance(state) says one pass started; mid_pass replays it
+        assert [_first_val(next(it2)) for _ in range(50)] == first
+
+    def test_eval_stream_is_single_pass_stored_order(self, tmp_path):
+        ds = DistributedShuffleDataSet(_store(tmp_path), num_shards=2,
+                                       shard_index=0)
+        vals = [_first_val(r) for r in ds.data(train=False)]
+        assert len(vals) == ds.local_size()
+        # stored order within each chunk: locally ascending runs of 5
+        for i in range(0, len(vals) - 1):
+            if i % 5 != 4:
+                assert vals[i + 1] == vals[i] + 1 or vals[i + 1] < vals[i]
+
+    def test_raw_stream_yields_keyed_byte_records(self, tmp_path):
+        path = _store(tmp_path)
+        ds = DistributedShuffleDataSet(path, decode=False)
+        it = ds.data(train=True)
+        rec = next(it)
+        assert isinstance(rec, ByteRecord)
+        assert rec.key[0] == path and len(rec.key) == 3
+
+    def test_more_shards_than_chunks_is_refused(self, tmp_path):
+        with pytest.raises(ValueError, match="chunk"):
+            DistributedShuffleDataSet(_store(tmp_path), num_shards=9,
+                                      shard_index=0)
+
+    def test_size_semantics_match_sharded_dataset(self, tmp_path):
+        ds = DistributedShuffleDataSet(_store(tmp_path), num_shards=2,
+                                       shard_index=1)
+        assert ds.size() == 37                      # global
+        assert ds.is_sharded() is True
+        assert ds.process_shard_count() == 2
+        assert ds.process_shard_index() == 1
+        assert 0 < ds.local_size() < 37
+
+
+class TestResizeResume:
+    def _consume_chunks(self, ds, it, n_chunks_to_eat, k, old_n, i):
+        assign = chunk_assignment(ds.reader.n_chunks, old_n, k, seed=0)
+        out = {}
+        for cid in assign[i][:n_chunks_to_eat]:
+            out[cid] = [_first_val(next(it)) for _ in
+                        range(ds.reader.chunk_record_count(cid))]
+        return out
+
+    def test_4_to_2_resize_is_bit_identical(self, tmp_path):
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        old_n, new_n = 4, 2
+        dss = [DistributedShuffleDataSet(path, num_shards=old_n,
+                                         shard_index=i, window_chunks=1)
+               for i in range(old_n)]
+        pre = {}
+        for i, ds in enumerate(dss):
+            it = ds.data(train=True)
+            pre.update(self._consume_chunks(ds, it, 1, 0, old_n, i))
+        states = [ds.get_position_state() for ds in dss]
+        assert all(s["chunks_done"] == 1 for s in states)
+
+        new_states = redistribute_chunk_positions(states, new_n, seed=0)
+        post = {}
+        for st in new_states:
+            ds2 = DistributedShuffleDataSet(
+                path, num_shards=new_n,
+                shard_index=int(st["shard_index"]), window_chunks=1)
+            ds2.set_position_state(st, mid_pass=True)
+            it = ds2.data(train=True)
+            for cid in st["remaining_chunks"]:
+                post[cid] = [_first_val(next(it)) for _ in
+                             range(ds2.reader.chunk_record_count(cid))]
+
+        # exactly-once across the resize: consumed chunks never repeat,
+        # remaining chunks all land, and each remaining chunk's record
+        # stream is bit-identical to what the old fleet would have read
+        assert not (set(pre) & set(post))
+        assert set(pre) | set(post) == set(range(12))
+        r = ChunkedRecordReader(path)
+        for cid in post:
+            recs = r.read_chunk(cid)
+            expect = [_first_val(decode_sample(*recs[j]))
+                      for j in chunk_record_order(len(recs), 0, cid,
+                                                  seed=0)]
+            assert post[cid] == expect, cid
+
+    def test_resize_before_any_pass_gives_fresh_states(self, tmp_path):
+        dss = [DistributedShuffleDataSet(_store(tmp_path), num_shards=2,
+                                         shard_index=i) for i in range(2)]
+        out = redistribute_chunk_positions(
+            [ds.get_position_state() for ds in dss], 4)
+        assert len(out) == 4
+        assert all("remaining_chunks" not in st for st in out)
+        assert all(st["passes_started"] == 0 for st in out)
+
+    def test_redistribute_validates_states(self, tmp_path):
+        dss = [DistributedShuffleDataSet(_store(tmp_path), num_shards=2,
+                                         shard_index=i) for i in range(2)]
+        states = [ds.get_position_state() for ds in dss]
+        with pytest.raises(ValueError, match="2 old shards"):
+            redistribute_chunk_positions(states[:1], 2)
+        dup = [dict(states[0]), dict(states[0])]
+        with pytest.raises(ValueError, match="do not cover"):
+            redistribute_chunk_positions(dup, 2)
+        with pytest.raises(ValueError, match="out of range"):
+            redistribute_chunk_positions(states, 99)
+
+
+class TestChunkExchange:
+    def test_streams_all_chunks_in_order_with_permutation(self, tmp_path):
+        r = ChunkedRecordReader(_store(tmp_path))
+        ex = ChunkExchange(r, [2, 0, 5],
+                           lambda n, cid: list(reversed(range(n))),
+                           depth=1)
+        seen = []
+        while True:
+            item = ex.next_chunk()
+            if item is None:
+                break
+            cid, records = item
+            seen.append(cid)
+            # permuted order with original stored indices attached
+            assert [i for _, i in records] == \
+                list(reversed(range(len(records))))
+        ex.close()
+        assert seen == [2, 0, 5]
+
+    def test_worker_error_propagates_to_consumer(self, tmp_path):
+        r = ChunkedRecordReader(_store(tmp_path))
+
+        def boom(n, cid):
+            raise RuntimeError("decode exploded")
+        ex = ChunkExchange(r, [0, 1], boom, depth=1)
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            while ex.next_chunk() is not None:
+                pass
+        ex.close()
+
+    def test_close_mid_stream_joins_worker(self, tmp_path):
+        r = ChunkedRecordReader(_store(tmp_path))
+        ex = ChunkExchange(r, list(range(8)),
+                           lambda n, cid: list(range(n)), depth=1)
+        ex.next_chunk()
+        ex.close()
+        assert not ex._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# satellite: ShardedDataSet drops the full list after slicing
+# ---------------------------------------------------------------------------
+
+class _Tracked:
+    def __init__(self, i):
+        self.i = i
+
+
+class TestShardedFootprint:
+    def test_full_list_dropped_when_sharded(self):
+        objs = [_Tracked(i) for i in range(100)]
+        refs = [weakref.ref(o) for o in objs]
+        ds = ShardedDataSet(objs, num_shards=4, shard_index=1)
+        del objs
+        gc.collect()
+        # peak-object accounting: only the shard's 25 objects survive
+        assert sum(1 for r in refs if r() is not None) == 25
+        assert ds._all is None
+        assert ds.size() == 100 and ds.local_size() == 25
+        assert [o.i for o in ds._local] == list(range(1, 100, 4))
+
+    def test_keep_all_opt_out_retains_everything(self):
+        objs = [_Tracked(i) for i in range(40)]
+        refs = [weakref.ref(o) for o in objs]
+        ds = ShardedDataSet(objs, num_shards=4, shard_index=0,
+                            keep_all=True)
+        del objs
+        gc.collect()
+        assert sum(1 for r in refs if r() is not None) == 40
+        assert ds._all is not None and ds.size() == 40
+
+    def test_single_shard_keeps_all_by_default(self):
+        ds = ShardedDataSet(list(range(10)))
+        assert ds._all == list(range(10))
+        assert ds.size() == ds.local_size() == 10
+
+
+# ---------------------------------------------------------------------------
+# satellite: chunk-size tuning candidates
+# ---------------------------------------------------------------------------
+
+class TestChunkRecordsCandidates:
+    def test_octave_scan_filters_by_shard_floor(self):
+        from bigdl_tpu.tuning.autotuner import chunk_records_candidates
+        cands = chunk_records_candidates(10_000, num_shards=1)
+        assert {c["chunk_records"] for c in cands} == \
+            {64, 128, 256, 512, 1024, 2048}
+        # 10k records / 2048-chunk = 5 chunks < 8 shards: filtered out
+        big_fleet = chunk_records_candidates(10_000, num_shards=8)
+        assert all(c["chunk_records"] < 2048 for c in big_fleet)
+        assert cands[0] == {"chunk_records": 64}
+
+
+# ---------------------------------------------------------------------------
+# optimizer wiring: epoch-end input-wait-fraction scalar
+# ---------------------------------------------------------------------------
+
+class TestOptimizerWiring:
+    def test_local_train_over_store_emits_wait_fraction(self, tmp_path):
+        """One real (tiny) epoch over the record store through the
+        LocalOptimizer: decode runs on the pipeline, and the epoch
+        boundary publishes the input-wait-fraction roll-up."""
+        import bigdl_tpu.nn as nn
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset import SampleToBatch
+
+        path = str(tmp_path / "train.bcs")
+        rs = np.random.RandomState(0)
+        write_sample_store(
+            path, (Sample(rs.rand(8).astype(np.float32),
+                          float(rs.randint(1, 4)))
+                   for _ in range(32)),
+            chunk_records=8)
+        store_ds = DistributedShuffleDataSet(path)
+        ds = store_ds >> SampleToBatch(8)
+        model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(),
+                              nn.Linear(8, 3), nn.LogSoftMax())
+        o = optim.Optimizer(model=model, dataset=ds,
+                            criterion=nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_input_pipeline(depth=2)
+        o.set_end_when(optim.max_epoch(1))
+        o.optimize()
+        # set at the epoch boundary; 0.0 is the never-set default, and
+        # a real epoch always measures a positive wait
+        assert 0.0 < o.metrics.get("input wait fraction") <= 1.0
+        # the store fed a whole epoch: every record seen exactly once
+        assert store_ds.reader.open_count == 4

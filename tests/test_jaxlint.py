@@ -517,6 +517,31 @@ class TestJX5HostOnlyImports:
             found = jaxlint.analyze_file(path, repo)
             assert [f for f in found if f.rule == "JX5"] == [], path
 
+    def test_distributed_data_plane_is_host_only(self):
+        """ISSUE 20 satellite pin: the chunked record store and the
+        distributed shuffle dataset (dataset/recordstore.py,
+        dataset/distributed.py) are pure host machinery — mmap reads,
+        footer parsing, chunk assignment arithmetic, and the exchange
+        thread must never touch a device; a module-level jax import in
+        either is a JX5 finding, and the shipped files are clean."""
+        for rel in ("bigdl_tpu/dataset/recordstore.py",
+                    "bigdl_tpu/dataset/distributed.py"):
+            out = lint(self.SRC, rel=rel)
+            assert rules(out) == ["JX5"], rel
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            path = os.path.join(repo, *rel.split("/"))
+            assert os.path.exists(path), path
+            found = jaxlint.analyze_file(path, repo)
+            assert [f for f in found if f.rule == "JX5"] == [], path
+        # decode callables that place batches may lazy-import jax
+        out = lint("""
+            def decode_to_device(self, data, label):
+                import jax
+                return jax.device_put(self._codec(data, label))
+        """, rel="bigdl_tpu/dataset/distributed.py")
+        assert out == []
+
 
 class TestAccumulationScanBodyFixtures:
     """ISSUE 10 satellite: pin the TPU-correctness contract of the

@@ -179,11 +179,28 @@ class TestPrefetchIterator:
         reg = default_registry()
         c = reg.counter("input_starvation_total",
                         "consumer blocked on an empty prefetch queue",
-                        labelnames=("pipeline",))
-        before = c.value(pipeline="starver")
+                        labelnames=("pipeline", "shard"))
+        before = c.value(pipeline="starver", shard="0")
         list(PrefetchIterator(starving(), depth=2, name="starver"))
-        assert c.value(pipeline="starver") > before
+        assert c.value(pipeline="starver", shard="0") > before
         assert reg.get("prefetch_queue_depth") is not None
+
+    def test_starvation_attributed_to_shard(self):
+        """Per-host attribution: a pipeline built for shard 3 counts
+        starvation under shard="3", not the default series."""
+        def starving():
+            for b in _batches([2] * 3):
+                time.sleep(0.02)
+                yield b
+
+        reg = default_registry()
+        c = reg.counter("input_starvation_total",
+                        "consumer blocked on an empty prefetch queue",
+                        labelnames=("pipeline", "shard"))
+        before = c.value(pipeline="sharded", shard="3")
+        list(PrefetchIterator(starving(), depth=2, name="sharded",
+                              shard=3))
+        assert c.value(pipeline="sharded", shard="3") > before
 
 
 # ---------------------------------------------------------------------------
