@@ -17,11 +17,18 @@ store (``dataset/recordstore.py``):
   shard-independent (pure in ``(seed, pass, chunk)``), which is what
   makes mid-epoch resume reconstructible across a host-count resize.
 * **Chunk-granular elastic resume.** Positions checkpoint as
-  (pass, chunks-consumed); :func:`redistribute_chunk_positions` deals
+  (pass, drained-chunk ids) — the ids actually finished, because the
+  window interleave drains chunks OUT of assignment order —
+  plus the in-flight pass's chunk list so post-resize snapshots carry
+  their override universe. :func:`redistribute_chunk_positions` deals
   the not-yet-consumed chunks of the interrupted pass across a NEW host
   count the same way elastic checkpoints redistribute optimizer shards
   (docs/ELASTICITY.md) — partially-consumed chunks replay in full
-  (chunk granularity), fully-consumed chunks never repeat.
+  (chunk granularity), fully-consumed chunks never repeat. Snapshot at
+  a quiesced pipeline: draining is accounted where THIS iterator is
+  pulled, so records still sitting in a PrefetchIterator queue count as
+  consumed (the optimizers are immune — they snapshot at pipeline
+  creation and replay with a consumer-side batch skip).
 
 Decode/augment stages attach as ordinary transforms and therefore run on
 the ``PrefetchIterator`` worker that pulls this dataset — per-host
@@ -210,7 +217,15 @@ class DistributedShuffleDataSet(PassRotationMixin, AbstractDataSet):
         self._decode = decode or None
         self._pos_lock = threading.Lock()
         self._pass_count = 0
-        self._chunks_done = 0
+        # ids actually drained from the in-flight pass — a SET of ids,
+        # not a count: the window interleave finishes chunks out of
+        # assignment order, so a prefix count would mark partially-read
+        # chunks consumed (kept in drain order for debuggability)
+        self._drained: list[int] = []
+        # the in-flight pass's full chunk list (the resume override
+        # after a resize, else the canonical assignment) — snapshots
+        # must report the list actually being iterated
+        self._pass_chunks = None
         self._resume_chunks = None
 
     # -- identity -------------------------------------------------------
@@ -269,7 +284,7 @@ class DistributedShuffleDataSet(PassRotationMixin, AbstractDataSet):
                 if idx + 1 >= len(records):
                     active.pop(j)
                     with self._pos_lock:
-                        self._chunks_done += 1
+                        self._drained.append(cid)
                 yield self._wrap(data, label, cid, stored_i)
         finally:
             ex.close()
@@ -285,15 +300,16 @@ class DistributedShuffleDataSet(PassRotationMixin, AbstractDataSet):
                     with self._pos_lock:
                         k = self._pass_count
                         self._pass_count = k + 1
-                        self._chunks_done = 0
                         override = self._resume_chunks
                         self._resume_chunks = None
-                    if override is not None:
-                        chunks = list(override)
-                    else:
-                        chunks = chunk_assignment(
-                            self._reader.n_chunks, self.num_shards,
-                            k)[self.shard_index]
+                        if override is not None:
+                            chunks = list(override)
+                        else:
+                            chunks = chunk_assignment(
+                                self._reader.n_chunks, self.num_shards,
+                                k)[self.shard_index]
+                        self._pass_chunks = list(chunks)
+                        self._drained = []
                     yield from self._iter_pass(k, chunks)
             return endless()
 
@@ -311,31 +327,86 @@ class DistributedShuffleDataSet(PassRotationMixin, AbstractDataSet):
 
     # -- resume contract ------------------------------------------------
     def get_position_state(self):
+        """Chunk-granular pipeline position.
+
+        ``drained_chunks`` are the ids actually drained from the
+        in-flight pass — NOT an assignment prefix (the window interleave
+        finishes chunks out of assignment order whenever
+        ``window_chunks`` > 1). ``remaining_chunks`` + ``override_pass``
+        carry the chunk list the in-flight (or pending resumed) pass
+        iterates, so a snapshot taken after a resize-resume round-trips
+        through checkpoints and a second
+        :func:`redistribute_chunk_positions` sees the real universe
+        instead of recomputing the canonical assignment.
+
+        QUIESCE CAVEAT: a chunk is accounted drained when its last
+        record is pulled from THIS iterator. Under a ``PrefetchIterator``
+        the puller is the worker thread, so records still sitting in the
+        prefetch queue count as consumed — snapshot with the pipeline
+        quiesced (worker closed / epoch boundary), or do what the
+        optimizers do: snapshot at pipeline creation, advance by the
+        consumer's pass-start, and replay with a consumer-side batch
+        skip (optim/optimizer.py ``_checkpoint``).
+        """
         with self._pos_lock:
-            return {"passes_started": self._pass_count,
-                    "chunks_done": self._chunks_done,
-                    "num_shards": self.num_shards,
-                    "shard_index": self.shard_index,
-                    "n_chunks": self._reader.n_chunks}
+            st = {"passes_started": self._pass_count,
+                  "chunks_done": len(self._drained),
+                  "drained_chunks": [int(c) for c in self._drained],
+                  "num_shards": self.num_shards,
+                  "shard_index": self.shard_index,
+                  "n_chunks": self._reader.n_chunks}
+            if self._resume_chunks is not None:
+                # resumed but not yet started: the override governs the
+                # NEXT pass to start (0-based index == _pass_count)
+                st["remaining_chunks"] = [int(c)
+                                          for c in self._resume_chunks]
+                st["override_pass"] = self._pass_count
+            elif self._pass_chunks is not None:
+                # the started pass's FULL list, drained ids included — a
+                # mid-pass replay restarts the pass (the optimizer's
+                # batch skip fast-forwards); redistribution subtracts
+                # drained_chunks itself
+                st["remaining_chunks"] = [int(c)
+                                          for c in self._pass_chunks]
+                st["override_pass"] = self._pass_count - 1
+            return st
 
     def set_position_state(self, state, mid_pass: bool = False):
         passes = int(np.asarray(state.get("passes_started", 0)))
         rc = state.get("remaining_chunks")
+        op = state.get("override_pass")
         with self._pos_lock:
             # mid_pass: replay pass k = passes-1 (mixin semantics)
             self._pass_count = passes - 1 if (mid_pass and passes > 0) \
                 else passes
-            self._chunks_done = 0
-            # one-shot ownership override for the replayed pass — set by
-            # redistribute_chunk_positions after a host-count resize
-            self._resume_chunks = list(rc) if rc is not None else None
+            self._drained = []
+            self._pass_chunks = None
+            # one-shot ownership override for the next pass to start —
+            # honored only when it was recorded FOR that pass (a state
+            # whose override names an already-completed pass falls back
+            # to the canonical assignment)
+            if rc is not None and (
+                    op is None or int(np.asarray(op)) == self._pass_count):
+                self._resume_chunks = [int(c) for c in rc]
+            else:
+                self._resume_chunks = None
 
     def advance_position_state(self, state):
+        """``state`` as it reads after the next pass STARTED from it
+        (the optimizers advance their pipeline-creation snapshot by the
+        consumer's progress — dataset/prefetch.py). A pending resume
+        override survives the advance — the pass being started IS the
+        override pass — while one describing the already-started pass
+        is dropped."""
         out = dict(state)
-        out["passes_started"] = \
-            int(np.asarray(state.get("passes_started", 0))) + 1
+        passes = int(np.asarray(state.get("passes_started", 0)))
+        out["passes_started"] = passes + 1
         out["chunks_done"] = 0
-        out.pop("remaining_chunks", None)
+        out["drained_chunks"] = []
+        op = state.get("override_pass")
+        if op is None or int(np.asarray(op)) != passes:
+            out.pop("remaining_chunks", None)
+            out.pop("override_pass", None)
         return out
 
     def close(self):
@@ -348,11 +419,17 @@ def redistribute_chunk_positions(states, new_num_shards: int, *, seed=None):
     redistribution (docs/ELASTICITY.md).
 
     ``states``: one ``get_position_state()`` dict per OLD shard (any
-    order). Chunk-granular contract: a chunk counts as consumed only
-    when fully drained — partially-read chunks replay in full on the new
-    fleet, fully-consumed chunks never repeat, and because within-chunk
-    record order is shard-independent the remaining stream reconstructs
-    bit-identically. Returns one state per NEW shard; apply each with
+    order), snapshotted at a QUIESCED pipeline (see
+    ``get_position_state``). Chunk-granular contract: a chunk counts as
+    consumed only when fully drained — the ``drained_chunks`` id set,
+    which under the window interleave is NOT an assignment prefix —
+    so partially-read chunks replay in full on the new fleet,
+    fully-consumed chunks never repeat, and because within-chunk record
+    order is shard-independent the remaining stream reconstructs
+    bit-identically. Chained resizes work: a snapshot taken during (or
+    before) a replayed pass carries its override chunk list, and the
+    re-deal is computed against THAT universe rather than the canonical
+    assignment. Returns one state per NEW shard; apply each with
     ``set_position_state(state, mid_pass=True)``.
     """
     if not states:
@@ -379,20 +456,63 @@ def redistribute_chunk_positions(states, new_num_shards: int, *, seed=None):
         raise ValueError(f"shard indices {sorted(seen)} do not cover "
                          f"0..{old_shards - 1}")
 
-    base = {"chunks_done": 0, "num_shards": new_num_shards,
-            "n_chunks": n_chunks}
-    if passes == 0:   # nothing started — fresh states, no override
+    base = {"chunks_done": 0, "drained_chunks": [],
+            "num_shards": new_num_shards, "n_chunks": n_chunks}
+
+    # Which pass is interrupted? Normally the last STARTED one
+    # (passes-1). A fleet snapshotted after a resize-restore but before
+    # the replay began reports a PENDING override for pass == passes —
+    # that pass is the interrupted one, with nothing drained yet.
+    def _op(st):
+        op = st.get("override_pass")
+        return None if op is None else int(np.asarray(op))
+
+    pending = [st for st in states if _op(st) == passes
+               and st.get("remaining_chunks") is not None]
+    if pending and len(pending) != len(states):
+        raise ValueError("mixed pending-resume and in-flight position "
+                         "states — not one quiesced snapshot of one "
+                         "fleet")
+    if pending:
+        k = passes
+    elif passes == 0:   # nothing started — fresh states, no override
         return [dict(base, passes_started=0, shard_index=s)
                 for s in range(new_num_shards)]
+    else:
+        k = passes - 1  # the interrupted pass
 
-    k = passes - 1    # the interrupted pass
-    assign = chunk_assignment(n_chunks, old_shards, k, seed=seed)
+    # Per-shard chunk universe for pass k: the state's own chunk list
+    # when it carries one (post-resize override / in-flight snapshot),
+    # else the canonical assignment. Consumed = union of the ids
+    # actually drained; legacy states without drained_chunks fall back
+    # to the prefix-count reading (only ever correct at
+    # window_chunks == 1, the pre-drained-set format).
+    assign = None
+
+    def _assignment():
+        nonlocal assign
+        if assign is None:
+            assign = chunk_assignment(n_chunks, old_shards, k, seed=seed)
+        return assign
+
+    universe = set()
     consumed = set()
     for st in states:
         s = int(st["shard_index"])
-        consumed.update(assign[s][:int(st["chunks_done"])])
+        rc = st.get("remaining_chunks")
+        if rc is not None:
+            universe.update(int(c) for c in rc)
+        else:
+            universe.update(_assignment()[s])
+        dr = st.get("drained_chunks")
+        if dr is not None:
+            consumed.update(int(c) for c in dr)
+        else:
+            consumed.update(_assignment()[s][:int(st.get("chunks_done",
+                                                         0))])
     remaining = [c for c in pass_chunk_order(n_chunks, k, seed=seed)
-                 if c not in consumed]
-    return [dict(base, passes_started=passes, shard_index=s,
-                 remaining_chunks=remaining[s::new_num_shards])
+                 if c in universe and c not in consumed]
+    return [dict(base, passes_started=k + 1, shard_index=s,
+                 remaining_chunks=remaining[s::new_num_shards],
+                 override_pass=k)
             for s in range(new_num_shards)]

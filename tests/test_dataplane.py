@@ -319,6 +319,209 @@ class TestResizeResume:
             redistribute_chunk_positions(states, 99)
 
 
+class TestResizeResumeWindowed:
+    """Resize-resume under the DEFAULT ``window_chunks=2``: the
+    interleave drains chunks OUT of assignment order, so consumption
+    accounting must be the actually-drained id set (``drained_chunks``),
+    never a prefix count, and snapshots taken during (or before) a
+    replayed pass must carry their override universe so chained resizes
+    stay exactly-once."""
+
+    def _consume(self, ds, it, n):
+        out = {}
+        for _ in range(n):
+            rec = next(it)
+            out.setdefault(rec.key[1], []).append(rec.key[2])
+        return out
+
+    def test_windowed_drain_is_not_an_assignment_prefix(self, tmp_path):
+        # 12 chunks x 5 records, 4 shards: 14 of the shard's 15 records
+        # drains two chunks that (at seed 0) are NOT the first two
+        # assigned, and leaves a third partially read
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        ds = DistributedShuffleDataSet(path, num_shards=4, shard_index=0,
+                                       decode=False)
+        it = ds.data(train=True)
+        touched = set(self._consume(ds, it, 14))
+        st = ds.get_position_state()
+        assign = chunk_assignment(12, 4, 0, seed=0)
+        assert st["chunks_done"] == len(st["drained_chunks"]) == 2
+        assert set(st["drained_chunks"]) != set(assign[0][:2])
+        partial = touched - set(st["drained_chunks"])
+        assert len(partial) == 1 and partial <= set(assign[0])
+
+    def test_4_to_2_resize_default_window_exactly_once(self, tmp_path):
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        old_n, new_n = 4, 2
+        dss = [DistributedShuffleDataSet(path, num_shards=old_n,
+                                         shard_index=i, decode=False)
+               for i in range(old_n)]
+        pre = {}
+        for ds in dss:
+            pre.update(self._consume(ds, ds.data(train=True), 14))
+        states = [ds.get_position_state() for ds in dss]
+        drained = set().union(*(s["drained_chunks"] for s in states))
+        # the hazard is live: at least one shard's drain set is not its
+        # assignment prefix (prefix accounting would lose/duplicate)
+        assign = chunk_assignment(12, old_n, 0, seed=0)
+        assert any(
+            set(s["drained_chunks"]) !=
+            set(assign[int(s["shard_index"])][:len(s["drained_chunks"])])
+            for s in states)
+
+        new_states = redistribute_chunk_positions(states, new_n, seed=0)
+        remaining = set().union(*(set(s["remaining_chunks"])
+                                  for s in new_states))
+        # exactly-once at chunk granularity: drained chunks never
+        # reappear, everything else (incl. partially-read chunks) does
+        assert not (drained & remaining)
+        assert drained | remaining == set(range(12))
+        partial = set(pre) - drained
+        assert partial and partial <= remaining
+
+        # replay on the new fleet (same default window): demuxed by
+        # chunk, every remaining chunk streams bit-identically to the
+        # pass-0 record-order oracle
+        post = {}
+        for st in new_states:
+            ds2 = DistributedShuffleDataSet(
+                path, num_shards=new_n,
+                shard_index=int(st["shard_index"]), decode=False)
+            ds2.set_position_state(st, mid_pass=True)
+            n = sum(ds2.reader.chunk_record_count(c)
+                    for c in st["remaining_chunks"])
+            post.update(self._consume(ds2, ds2.data(train=True), n))
+        assert set(post) == remaining
+        r = ChunkedRecordReader(path)
+        for cid, stored in post.items():
+            assert stored == chunk_record_order(
+                len(r.read_chunk(cid)), 0, cid, seed=0), cid
+
+    def test_chained_resize_mid_replayed_pass(self, tmp_path):
+        """A checkpoint DURING the replayed pass reports the override
+        chunk list, so a second redistribution re-deals against that
+        universe instead of the canonical 2-shard assignment."""
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        dss = [DistributedShuffleDataSet(path, num_shards=4,
+                                         shard_index=i, decode=False)
+               for i in range(4)]
+        for ds in dss:
+            self._consume(ds, ds.data(train=True), 14)
+        states = [ds.get_position_state() for ds in dss]
+        drained1 = set().union(*(s["drained_chunks"] for s in states))
+        mid = redistribute_chunk_positions(states, 2, seed=0)
+
+        ds2s = []
+        for st in mid:
+            ds2 = DistributedShuffleDataSet(
+                path, num_shards=2, shard_index=int(st["shard_index"]),
+                decode=False)
+            ds2.set_position_state(st, mid_pass=True)
+            it = ds2.data(train=True)
+            while not ds2.get_position_state()["drained_chunks"]:
+                next(it)
+            ds2s.append(ds2)
+        states2 = [ds.get_position_state() for ds in ds2s]
+        drained2 = set().union(*(s["drained_chunks"] for s in states2))
+        # the mid-replay snapshot carries the override universe
+        for st in states2:
+            assert set(st["remaining_chunks"]) == set(
+                mid[int(st["shard_index"])]["remaining_chunks"])
+
+        final = redistribute_chunk_positions(states2, 3, seed=0)
+        remaining = set().union(*(set(s["remaining_chunks"])
+                                  for s in final))
+        # exactly-once across BOTH resizes
+        assert not (remaining & (drained1 | drained2))
+        assert remaining | drained1 | drained2 == set(range(12))
+        # and the record order on the final fleet still keys to pass 0
+        r = ChunkedRecordReader(path)
+        for st in final:
+            ds3 = DistributedShuffleDataSet(
+                path, num_shards=3, shard_index=int(st["shard_index"]),
+                decode=False)
+            ds3.set_position_state(st, mid_pass=True)
+            n = sum(ds3.reader.chunk_record_count(c)
+                    for c in st["remaining_chunks"])
+            for cid, stored in self._consume(
+                    ds3, ds3.data(train=True), n).items():
+                assert stored == chunk_record_order(
+                    len(r.read_chunk(cid)), 0, cid, seed=0), cid
+
+    def test_pending_resume_snapshot_roundtrips_via_advance(self,
+                                                            tmp_path):
+        """The optimizer checkpoint flow right after a resize-restore:
+        position is snapshotted at pipeline creation (override still
+        pending), advanced by the consumer's pass-start, saved, and
+        restored — the override must survive the round trip and the
+        replay must match the direct one bit-for-bit."""
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        dss = [DistributedShuffleDataSet(path, num_shards=4,
+                                         shard_index=i, decode=False)
+               for i in range(4)]
+        for ds in dss:
+            self._consume(ds, ds.data(train=True), 14)
+        new_states = redistribute_chunk_positions(
+            [ds.get_position_state() for ds in dss], 2, seed=0)
+
+        st = new_states[0]
+        a = DistributedShuffleDataSet(path, num_shards=2, shard_index=0,
+                                      decode=False)
+        a.set_position_state(st, mid_pass=True)
+        snap = a.get_position_state()       # pipeline-creation snapshot
+        assert list(snap["remaining_chunks"]) == \
+            list(st["remaining_chunks"])
+        it = a.data(train=True)
+        direct = [next(it).key for _ in range(20)]
+
+        saved = a.advance_position_state(snap)   # consumer started it
+        assert list(saved["remaining_chunks"]) == \
+            list(st["remaining_chunks"])
+        b = DistributedShuffleDataSet(path, num_shards=2, shard_index=0,
+                                      decode=False)
+        b.set_position_state(saved, mid_pass=True)
+        itb = b.data(train=True)
+        assert [next(itb).key for _ in range(20)] == direct
+
+    def test_redistribute_pending_states_before_replay(self, tmp_path):
+        """Chained resize with ZERO progress between: states restored
+        but never iterated report the pending override, and the re-deal
+        preserves the universe and the original pass's record order."""
+        path = _store(tmp_path, n_records=60, chunk_records=5)
+        dss = [DistributedShuffleDataSet(path, num_shards=4,
+                                         shard_index=i, decode=False)
+               for i in range(4)]
+        for ds in dss:
+            self._consume(ds, ds.data(train=True), 14)
+        states = [ds.get_position_state() for ds in dss]
+        drained = set().union(*(s["drained_chunks"] for s in states))
+        mid = redistribute_chunk_positions(states, 2, seed=0)
+
+        pend = []
+        for st in mid:
+            d = DistributedShuffleDataSet(
+                path, num_shards=2, shard_index=int(st["shard_index"]),
+                decode=False)
+            d.set_position_state(st, mid_pass=True)
+            pend.append(d.get_position_state())
+        final = redistribute_chunk_positions(pend, 3, seed=0)
+        remaining = set().union(*(set(s["remaining_chunks"])
+                                  for s in final))
+        assert remaining == set(range(12)) - drained
+        # record order still keyed to the interrupted pass (pass 0)
+        r = ChunkedRecordReader(path)
+        st = final[0]
+        d3 = DistributedShuffleDataSet(path, num_shards=3, shard_index=0,
+                                       decode=False)
+        d3.set_position_state(st, mid_pass=True)
+        n = sum(d3.reader.chunk_record_count(c)
+                for c in st["remaining_chunks"])
+        for cid, stored in self._consume(
+                d3, d3.data(train=True), n).items():
+            assert stored == chunk_record_order(
+                len(r.read_chunk(cid)), 0, cid, seed=0), cid
+
+
 class TestChunkExchange:
     def test_streams_all_chunks_in_order_with_permutation(self, tmp_path):
         r = ChunkedRecordReader(_store(tmp_path))
